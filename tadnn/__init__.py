@@ -1,0 +1,17 @@
+"""Short import alias for torch_automatic_distributed_neural_network_tpu."""
+
+import importlib as _importlib
+import sys as _sys
+
+from torch_automatic_distributed_neural_network_tpu import *  # noqa: F401,F403
+from torch_automatic_distributed_neural_network_tpu import __version__  # noqa: F401
+
+import torch_automatic_distributed_neural_network_tpu as _pkg
+
+# Make both `import tadnn.models` and `tadnn.models.X` resolve to the real
+# subpackages: register the sys.modules alias AND bind the attribute.
+_self = _sys.modules[__name__]
+for _name in ("models", "ops", "parallel", "utils", "data", "training"):
+    _mod = _importlib.import_module(_pkg.__name__ + "." + _name)
+    _sys.modules.setdefault(__name__ + "." + _name, _mod)
+    setattr(_self, _name, _mod)

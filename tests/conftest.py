@@ -1,0 +1,37 @@
+"""Test config: force an 8-device simulated-CPU JAX before backend init.
+
+The driver environment forces the experimental `axon` TPU platform via
+PYTHONPATH sitecustomize + JAX_PLATFORMS=axon (SURVEY.md §7).  Tests need
+deterministic multi-device semantics, so we override to CPU with 8 fake
+devices (SURVEY.md §4) — this must happen before any test imports jax.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+import jax  # noqa: E402
+
+# The axon sitecustomize imports jax at interpreter start with
+# JAX_PLATFORMS=axon already latched into the config — override it
+# programmatically (backends have not initialized yet at conftest time).
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 simulated devices, got {devs}"
+    return devs

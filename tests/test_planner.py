@@ -1,0 +1,127 @@
+"""Partition planner unit tests (component C2) — pure specs, no arrays."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import torch_automatic_distributed_neural_network_tpu as tad
+from torch_automatic_distributed_neural_network_tpu import planner
+
+
+class Shape:
+    def __init__(self, *shape, dtype=jnp.float32):
+        self.shape = shape
+        self.dtype = dtype
+
+
+def transformer_like_params():
+    return {
+        "embed": {"embedding": Shape(1024, 256)},
+        "layers_0": {
+            "attn": {
+                "q_proj": {"kernel": Shape(256, 256), "bias": Shape(256)},
+                "o_proj": {"kernel": Shape(256, 256)},
+            },
+            "mlp": {
+                "up_proj": {"kernel": Shape(256, 1024)},
+                "down_proj": {"kernel": Shape(1024, 256)},
+            },
+            "norm": {"scale": Shape(256)},
+        },
+        "lm_head": {"kernel": Shape(256, 1024)},
+    }
+
+
+def test_dp_replicates_everything(devices8):
+    mesh = tad.build_mesh(data=8)
+    specs = planner.param_spec_tree(transformer_like_params(), mesh, "dp")
+    for leaf in jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P)):
+        assert leaf == P()
+
+
+def test_tp_megatron_pattern(devices8):
+    mesh = tad.build_mesh(tensor=8)
+    specs = planner.param_spec_tree(transformer_like_params(), mesh, "tp")
+    assert specs["layers_0"]["attn"]["q_proj"]["kernel"] == P(None, "tensor")
+    assert specs["layers_0"]["attn"]["q_proj"]["bias"] == P("tensor")
+    assert specs["layers_0"]["attn"]["o_proj"]["kernel"] == P("tensor")
+    assert specs["layers_0"]["mlp"]["up_proj"]["kernel"] == P(None, "tensor")
+    assert specs["layers_0"]["mlp"]["down_proj"]["kernel"] == P("tensor")
+    assert specs["layers_0"]["norm"]["scale"] == P()
+    assert specs["embed"]["embedding"] == P("tensor")
+    assert specs["lm_head"]["kernel"] == P(None, "tensor")
+
+
+def test_fsdp_shards_largest_divisible_dim(devices8):
+    mesh = tad.build_mesh(fsdp=8)
+    specs = planner.param_spec_tree(transformer_like_params(), mesh, "fsdp")
+    # up_proj kernel (256, 1024): largest dim 1024 divisible by 8
+    assert specs["layers_0"]["mlp"]["up_proj"]["kernel"] == P(None, "fsdp")
+    # norm scale (256,): divisible -> sharded too (ZeRO-3 shards everything)
+    assert specs["layers_0"]["norm"]["scale"] == P("fsdp")
+
+
+def test_fsdp_indivisible_stays_replicated(devices8):
+    mesh = tad.build_mesh(fsdp=8)
+    specs = planner.param_spec_tree({"w": Shape(7, 13)}, mesh, "fsdp")
+    assert specs["w"] == P()
+
+
+def test_tp_fsdp_combines(devices8):
+    mesh = tad.build_mesh(tensor=2, fsdp=4)
+    specs = planner.param_spec_tree(transformer_like_params(), mesh, "tp_fsdp")
+    # column-split on tensor, remaining (largest free) dim on fsdp
+    assert specs["layers_0"]["mlp"]["up_proj"]["kernel"] == P("fsdp", "tensor")
+    assert specs["layers_0"]["mlp"]["down_proj"]["kernel"] == P("tensor", "fsdp")
+
+
+def test_tp_indivisible_falls_back(devices8):
+    mesh = tad.build_mesh(tensor=8)
+    # 9 not divisible by 8 -> replicate instead of crashing
+    specs = planner.param_spec_tree(
+        {"q_proj": {"kernel": Shape(4, 9)}}, mesh, "tp"
+    )
+    assert specs["q_proj"]["kernel"] == P()
+
+
+def test_batch_spec(devices8):
+    mesh = tad.build_mesh(data=2, fsdp=4)
+    assert planner.batch_partition_spec(mesh) == P(("data", "fsdp"))
+    mesh = tad.build_mesh(tensor=8)
+    assert planner.batch_partition_spec(mesh) == P(None)
+
+
+def test_auto_small_model_is_dp(devices8):
+    abstract = {"w": Shape(16, 16)}
+    strategy, degrees = planner.choose_strategy(
+        abstract, tad.detect()
+    )
+    assert strategy == "dp"
+    assert degrees == {"data": 8}
+
+
+def test_auto_huge_transformer_is_tp_fsdp(devices8):
+    # ~8 GB of params in fp32 -> cannot DP on 8 GB cpu "HBM"
+    abstract = {
+        "layers_0": {"mlp": {"up_proj": {"kernel": Shape(16384, 4 * 16384)}}}
+    }
+    strategy, degrees = planner.choose_strategy(abstract, tad.detect())
+    assert strategy == "tp_fsdp"
+    assert degrees["tensor"] * degrees["fsdp"] == 8
+
+
+def test_make_plan_end_to_end(devices8):
+    plan = planner.make_plan(transformer_like_params(), strategy="tp_fsdp")
+    assert plan.strategy == "tp_fsdp"
+    d = tad.mesh_degrees(plan.mesh)
+    assert d["tensor"] * d["fsdp"] == 8
+    assert plan.remat  # planner turns on checkpointing for fsdp strategies
+    assert "tensor" in str(plan.describe())
+
+
+def test_make_plan_explicit_mesh_auto_resolves(devices8):
+    mesh = tad.build_mesh(fsdp=8)
+    plan = planner.make_plan(transformer_like_params(), mesh=mesh)
+    assert plan.strategy == "fsdp"

@@ -1,0 +1,58 @@
+"""Topology / mesh construction tests (component C10)."""
+
+import jax
+import numpy as np
+import pytest
+
+import torch_automatic_distributed_neural_network_tpu as tad
+from torch_automatic_distributed_neural_network_tpu import topology
+
+
+def test_detect(devices8):
+    topo = topology.detect()
+    assert topo.num_devices == 8
+    assert topo.platform == "cpu"
+    assert not topo.is_multihost
+
+
+def test_default_mesh_is_pure_dp(devices8):
+    mesh = tad.build_mesh()
+    d = tad.mesh_degrees(mesh)
+    assert d["data"] == 8
+    assert all(v == 1 for k, v in d.items() if k != "data")
+
+
+def test_mesh_axes_inference(devices8):
+    mesh = tad.build_mesh(tensor=2, fsdp=-1)
+    d = tad.mesh_degrees(mesh)
+    assert d["tensor"] == 2 and d["fsdp"] == 4
+
+
+def test_mesh_explicit_product_must_divide(devices8):
+    with pytest.raises(ValueError):
+        tad.build_mesh(tensor=3)
+
+
+def test_mesh_auto_expand_data(devices8):
+    # specifying only tensor=2 absorbs the rest into data
+    mesh = tad.build_mesh(tensor=2)
+    d = tad.mesh_degrees(mesh)
+    assert d["tensor"] == 2 and d["data"] == 4
+
+
+def test_two_infer_axes_rejected(devices8):
+    with pytest.raises(ValueError):
+        tad.build_mesh(tensor=-1, fsdp=-1)
+
+
+def test_single_device_mesh():
+    mesh = tad.single_device_mesh()
+    assert mesh.devices.size == 1
+    assert mesh.axis_names == topology.MESH_AXES
+
+
+def test_mesh_covers_all_devices(devices8):
+    mesh = tad.build_mesh(data=2, fsdp=2, tensor=2)
+    assert sorted(d.id for d in mesh.devices.flatten()) == sorted(
+        d.id for d in jax.devices()
+    )

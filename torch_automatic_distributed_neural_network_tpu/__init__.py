@@ -1,0 +1,53 @@
+"""TPU-native automatic distributed neural-network framework.
+
+A ground-up JAX/XLA re-design of the capability surface of
+``ngrabaskas/Torch-Automatic-Distributed-Neural-Network`` (see SURVEY.md):
+one-line ``AutoDistribute(model)`` that shards any model across a TPU mesh,
+an automatic partition planner, and first-class DP / FSDP / TP / SP / CP /
+PP / EP parallelism — single-controller GSPMD instead of the reference's
+one-process-per-GPU NCCL world.
+
+Short alias::
+
+    import torch_automatic_distributed_neural_network_tpu as tadnn
+    # or:  import tadnn
+"""
+
+from .core import AutoDistribute, TrainState, autodistribute
+from .planner import (
+    Rule,
+    ShardPlan,
+    TRANSFORMER_RULES,
+    make_plan,
+    param_spec_tree,
+)
+from .topology import (
+    MESH_AXES,
+    Topology,
+    build_mesh,
+    detect,
+    initialize_distributed,
+    mesh_degrees,
+    single_device_mesh,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "AutoDistribute",
+    "TrainState",
+    "autodistribute",
+    "Rule",
+    "ShardPlan",
+    "TRANSFORMER_RULES",
+    "make_plan",
+    "param_spec_tree",
+    "MESH_AXES",
+    "Topology",
+    "build_mesh",
+    "detect",
+    "initialize_distributed",
+    "mesh_degrees",
+    "single_device_mesh",
+    "__version__",
+]

@@ -1,0 +1,300 @@
+"""`AutoDistribute` — the one-line user entrypoint (component C1).
+
+Reference capability (SURVEY.md C1; BASELINE.json:5,7): wrap a model in one
+line, shard it across all visible devices, return something trainable; be a
+functional no-op on a single device.
+
+TPU-native realization (SURVEY.md §3.3): instead of per-module wrappers and
+gradient hooks in a one-process-per-device SPMD world, `AutoDistribute`
+builds a `ShardPlan` (mesh + PartitionSpec pytree, see planner.py) and jits
+ONE train step over it with `in_shardings`/`out_shardings`/donation.  GSPMD
+inserts every collective; after the first compile there is no Python in the
+hot loop.  On one device the plan is trivial and the wrapper is exactly
+`jit(train_step)` — the no-op path doubles as the correctness oracle for
+every parallel config (same loss curve on 1 vs N devices).
+
+Usage::
+
+    model = GPT2(config)                      # flax module
+    ad = AutoDistribute(model, optimizer=optax.adamw(3e-4),
+                        loss_fn=next_token_loss)
+    state = ad.init(jax.random.key(0), sample_batch)
+    for batch in data:
+        state, metrics = ad.step(state, batch)
+
+`loss_fn(params, batch, rng, apply_fn) -> loss | (loss, aux_dict)`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import planner as planner_mod
+from . import topology as topo_mod
+from .training.optim import opt_state_spec_tree
+
+
+@struct.dataclass
+class TrainState:
+    """Minimal functional train state; a pytree, shardable leaf-by-leaf."""
+
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    rng: jax.Array
+
+
+LossFn = Callable[..., Any]  # (params, batch, rng, apply_fn) -> loss | (loss, aux)
+
+
+class AutoDistribute:
+    """One-line automatic distribution of a model across a TPU mesh.
+
+    Parameters
+    ----------
+    model:
+        A flax ``nn.Module`` (anything with ``.init``/``.apply``), or
+        ``None`` if ``init_fn`` is given.
+    optimizer:
+        An optax ``GradientTransformation``.  Defaults to ``optax.adamw(1e-3)``.
+    loss_fn:
+        ``(params, batch, rng, apply_fn) -> loss`` or ``(loss, aux_dict)``.
+    init_fn:
+        ``(rng, batch) -> params`` — overrides ``model.init``.
+    strategy:
+        'auto' | 'dp' | 'fsdp' | 'tp' | 'tp_fsdp'.  'auto' picks from model
+        size vs HBM (planner.choose_strategy).
+    mesh:
+        Explicit ``jax.sharding.Mesh``; built from strategy if omitted.
+    remat:
+        Force gradient checkpointing of the loss (jax.checkpoint).  Default:
+        planner decides (on for fsdp/tp_fsdp).
+    donate:
+        Donate the input state buffers to the step (halves peak HBM).
+    """
+
+    def __init__(
+        self,
+        model: Any = None,
+        *,
+        optimizer: optax.GradientTransformation | None = None,
+        loss_fn: LossFn | None = None,
+        init_fn: Callable[..., Any] | None = None,
+        strategy: str = "auto",
+        mesh: Mesh | None = None,
+        rules: Sequence[planner_mod.Rule] = planner_mod.TRANSFORMER_RULES,
+        remat: bool | None = None,
+        donate: bool = True,
+        devices: Sequence[jax.Device] | None = None,
+    ):
+        if model is None and init_fn is None:
+            raise ValueError("Provide a model or an init_fn")
+        self.model = model
+        self.optimizer = optimizer or optax.adamw(1e-3)
+        self._loss_fn = loss_fn
+        self._init_fn = init_fn or (lambda rng, batch: model.init(rng, _model_input(batch)))
+        self._strategy = strategy
+        self._mesh = mesh
+        self._rules = rules
+        self._remat = remat
+        self._donate = donate
+        self._devices = list(devices) if devices is not None else None
+        self.plan: planner_mod.ShardPlan | None = None
+        self._step_fn = None
+        self._apply_fn = model.apply if model is not None else None
+
+    # -- planning -----------------------------------------------------------
+
+    def build_plan(self, rng: jax.Array, sample_batch: Any) -> planner_mod.ShardPlan:
+        """Trace the init to abstract shapes and run the partition planner."""
+        abstract = jax.eval_shape(self._init_fn, rng, sample_batch)
+        self.plan = planner_mod.make_plan(
+            abstract,
+            mesh=self._mesh,
+            strategy=self._strategy,
+            rules=self._rules,
+            devices=self._devices,
+            remat=self._remat,
+        )
+        return self.plan
+
+    @property
+    def mesh(self) -> Mesh:
+        assert self.plan is not None, "call init() or build_plan() first"
+        return self.plan.mesh
+
+    def state_shardings(self, state_abstract: Any) -> Any:
+        """NamedSharding pytree for a TrainState, derived from the plan."""
+        plan = self.plan
+        assert plan is not None
+        mesh = plan.mesh
+
+        def ns(spec):
+            return NamedSharding(mesh, spec)
+
+        opt_specs = opt_state_spec_tree(
+            state_abstract.opt_state,
+            state_abstract.params,
+            plan.param_specs,
+        )
+        return TrainState(
+            step=ns(P()),
+            params=jax.tree.map(ns, plan.param_specs,
+                                is_leaf=lambda x: isinstance(x, P)),
+            opt_state=jax.tree.map(ns, opt_specs,
+                                   is_leaf=lambda x: isinstance(x, P)),
+            rng=ns(P()),
+        )
+
+    # -- init ---------------------------------------------------------------
+
+    def init(self, rng: jax.Array, sample_batch: Any) -> TrainState:
+        """Initialize a sharded TrainState directly on-device.
+
+        Params are materialized already sharded (init jitted with
+        ``out_shardings``), so models larger than one chip's HBM never
+        exist unsharded anywhere — the FSDP init path (BASELINE.json:11).
+        """
+        if self.plan is None:
+            self.build_plan(rng, sample_batch)
+        self._check_batch(sample_batch)
+
+        def make_state(rng):
+            init_rng, state_rng = jax.random.split(rng)
+            params = self._init_fn(init_rng, sample_batch)
+            opt_state = self.optimizer.init(params)
+            return TrainState(
+                step=jnp.zeros((), jnp.int32),
+                params=params,
+                opt_state=opt_state,
+                rng=state_rng,
+            )
+
+        abstract = jax.eval_shape(make_state, rng)
+        shardings = self.state_shardings(abstract)
+        state = jax.jit(make_state, out_shardings=shardings)(rng)
+        self._compile_step(abstract, shardings)
+        return state
+
+    def _check_batch(self, batch) -> None:
+        """Fail with a readable message when the global batch does not divide
+        over the data axes (instead of a raw pjit sharding error)."""
+        plan = self.plan
+        assert plan is not None
+        degrees = topo_mod.mesh_degrees(plan.mesh)
+        dp = 1
+        for axes in plan.batch_spec:
+            for ax in axes if isinstance(axes, tuple) else (axes,):
+                if ax:
+                    dp *= degrees.get(ax, 1)
+        if dp <= 1:
+            return
+        for leaf in jax.tree.leaves(batch):
+            shape = getattr(leaf, "shape", ())
+            if not shape:
+                continue  # scalar batch entries are replicated, not split
+            n = shape[0]
+            if n is not None and n % dp:
+                raise ValueError(
+                    f"Global batch size {n} is not divisible by the "
+                    f"data-parallel degree {dp} (mesh {degrees}). Increase "
+                    f"the batch size or reduce the data/fsdp mesh axes."
+                )
+
+    # -- the train step -----------------------------------------------------
+
+    def _loss_for(self, params, batch, rng):
+        if self._loss_fn is None:
+            raise ValueError("AutoDistribute needs a loss_fn to train")
+        out = self._loss_fn(params, batch, rng, self._apply_fn)
+        if isinstance(out, tuple):
+            return out
+        return out, {}
+
+    def _compile_step(self, state_abstract, shardings):
+        plan = self.plan
+        assert plan is not None
+        batch_sharding = plan.batch_sharding()
+
+        loss_for = self._loss_for
+        if plan.remat:
+            # Gradient checkpointing (C7): recompute everything but matmul
+            # outputs in the backward pass.
+            loss_for = jax.checkpoint(
+                loss_for,
+                policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+                static_argnums=(),
+            )
+
+        def train_step(state: TrainState, batch):
+            step_rng = jax.random.fold_in(state.rng, state.step)
+            grad_fn = jax.value_and_grad(loss_for, has_aux=True)
+            (loss, aux), grads = grad_fn(state.params, batch, step_rng)
+            updates, opt_state = self.optimizer.update(
+                grads, state.opt_state, state.params
+            )
+            params = optax.apply_updates(state.params, updates)
+            new_state = dataclasses.replace(
+                state,
+                step=state.step + 1,
+                params=params,
+                opt_state=opt_state,
+            )
+            metrics = {"loss": loss, **aux}
+            return new_state, metrics
+
+        self._step_fn = jax.jit(
+            train_step,
+            in_shardings=(shardings, batch_sharding),
+            out_shardings=(shardings, None),
+            donate_argnums=(0,) if self._donate else (),
+        )
+
+    def step(self, state: TrainState, batch) -> tuple[TrainState, dict]:
+        """One optimizer step.  Hot loop: dispatch-only after first compile."""
+        assert self._step_fn is not None, "call init() first"
+        return self._step_fn(state, batch)
+
+    # -- inference ----------------------------------------------------------
+
+    @functools.cached_property
+    def _fwd(self):
+        assert self._apply_fn is not None
+        return jax.jit(self._apply_fn)
+
+    def __call__(self, params, *args, **kwargs):
+        """Forward pass — parity with calling the wrapped reference model."""
+        return self._fwd(params, *args, **kwargs)
+
+    def shard_batch(self, batch):
+        """Place a host-local batch onto the mesh with the plan's sharding."""
+        assert self.plan is not None
+        sharding = self.plan.batch_sharding()
+        return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def _model_input(batch):
+    """Extract the model input from a batch dict/tuple for model.init."""
+    if isinstance(batch, dict):
+        for k in ("x", "inputs", "input_ids", "image", "images", "tokens"):
+            if k in batch:
+                return batch[k]
+        return next(iter(batch.values()))
+    if isinstance(batch, (tuple, list)):
+        return batch[0]
+    return batch
+
+
+def autodistribute(
+    model: Any = None, **kwargs
+) -> AutoDistribute:
+    """Functional alias: ``autodistribute(model, optimizer=..., loss_fn=...)``."""
+    return AutoDistribute(model, **kwargs)

@@ -1,0 +1,1 @@
+"""Input pipelines: synthetic datasets + per-host sharded loaders (C13)."""

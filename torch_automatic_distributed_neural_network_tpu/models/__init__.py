@@ -1,0 +1,5 @@
+"""Model zoo mirroring the reference's example models (SURVEY.md C11/C12)."""
+
+from .mlp import MLP
+
+__all__ = ["MLP"]

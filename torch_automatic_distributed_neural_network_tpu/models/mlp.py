@@ -1,0 +1,25 @@
+"""3-layer MLP — the reference's MNIST example model (BASELINE.json:7).
+
+The single-device AutoDistribute no-op config trains this on MNIST; it is
+also the parity oracle for DP tests (same loss curve on 1 vs N devices).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (512, 256, 10)
+
+    @nn.compact
+    def __call__(self, x, rngs=None):
+        x = x.reshape((x.shape[0], -1)).astype(jnp.float32)
+        for i, f in enumerate(self.features):
+            x = nn.Dense(f, name=f"dense_{i}")(x)
+            if i < len(self.features) - 1:
+                x = nn.relu(x)
+        return x

@@ -1,0 +1,1 @@
+"""TPU-tuned ops: attention (XLA + Pallas), checkpoint policies, layers."""

@@ -1,0 +1,5 @@
+"""Parallel execution strategies (SURVEY.md §2.2) and the comm backend."""
+
+from . import collectives
+
+__all__ = ["collectives"]

@@ -1,0 +1,188 @@
+"""Communication backend (component C8).
+
+Reference capability (SURVEY.md C8): NCCL allreduce / allgather /
+reduce-scatter / broadcast via ``torch.distributed`` ProcessGroup.
+
+TPU-native realization: XLA collectives over ICI (in-slice) and DCN
+(cross-slice).  Under ``pjit``/GSPMD the compiler inserts them from the
+sharding annotations; this module provides the *explicit* tier — thin,
+named wrappers usable inside ``shard_map`` regions (ring attention,
+pipeline ppermute, MoE all_to_all) — plus the allreduce bus-bandwidth
+microbenchmark that BASELINE.json:2 names as a headline metric.
+
+Bus bandwidth follows the NCCL-tests convention so numbers are comparable
+with the reference's NCCL benchmarks: for allreduce on n devices,
+``bus_bw = (2*(n-1)/n) * bytes / time``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import statistics
+import time
+from functools import partial
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+
+# ---------------------------------------------------------------------------
+# Explicit collectives (shard_map tier)
+# ---------------------------------------------------------------------------
+
+def allreduce(x: jax.Array, axis: str | tuple[str, ...]) -> jax.Array:
+    """Sum-allreduce over a mesh axis (NCCL allreduce analog)."""
+    return jax.lax.psum(x, axis)
+
+
+def allmean(x: jax.Array, axis: str | tuple[str, ...]) -> jax.Array:
+    return jax.lax.pmean(x, axis)
+
+
+def allgather(x: jax.Array, axis: str, *, tiled: bool = True, gather_dim: int = 0) -> jax.Array:
+    """Concatenate shards along ``gather_dim`` (NCCL allgather analog)."""
+    return jax.lax.all_gather(x, axis, axis=gather_dim, tiled=tiled)
+
+
+def reduce_scatter(x: jax.Array, axis: str, *, scatter_dim: int = 0) -> jax.Array:
+    """Sum-reduce then scatter along ``scatter_dim`` (NCCL reduce-scatter)."""
+    return jax.lax.psum_scatter(x, axis, scatter_dimension=scatter_dim, tiled=True)
+
+
+def broadcast(x: jax.Array, axis: str, root: int = 0) -> jax.Array:
+    """Every shard receives the root shard's value (NCCL broadcast analog)."""
+    idx = jax.lax.axis_index(axis)
+    n = jax.lax.axis_size(axis)
+    # send root's value around the ring: select root's contribution of an
+    # allreduce of the masked value
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return jax.lax.psum(masked, axis)
+
+
+def all_to_all(
+    x: jax.Array, axis: str, *, split_dim: int, concat_dim: int
+) -> jax.Array:
+    """Transpose shard ownership (Ulysses / MoE dispatch primitive)."""
+    return jax.lax.all_to_all(
+        x, axis, split_axis=split_dim, concat_axis=concat_dim, tiled=True
+    )
+
+
+def ppermute_ring(x: jax.Array, axis: str, shift: int = 1) -> jax.Array:
+    """Rotate shards around the ring (ring attention / pipeline hop)."""
+    n = jax.lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis, perm)
+
+
+def axis_index(axis: str) -> jax.Array:
+    return jax.lax.axis_index(axis)
+
+
+# ---------------------------------------------------------------------------
+# Microbenchmark (BASELINE.json:2 — allreduce bus bandwidth)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class CollectiveBenchResult:
+    op: str
+    n_devices: int
+    size_bytes: int
+    time_s: float
+    alg_bw_gbps: float  # bytes / time
+    bus_bw_gbps: float  # NCCL-tests bus-bandwidth convention
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _bus_factor(op: str, n: int) -> float:
+    if op == "allreduce":
+        return 2 * (n - 1) / n
+    if op in ("allgather", "reduce_scatter"):
+        return (n - 1) / n
+    if op == "all_to_all":
+        return (n - 1) / n
+    return 1.0
+
+
+def bench_collective(
+    op: str = "allreduce",
+    size_bytes: int = 64 * 2**20,
+    *,
+    mesh: Mesh | None = None,
+    axis: str = "data",
+    iters: int = 10,
+    warmup: int = 3,
+    dtype=jnp.float32,
+) -> CollectiveBenchResult:
+    """Time one collective over one mesh axis; report alg + bus bandwidth."""
+    if mesh is None:
+        from .. import topology
+
+        mesh = topology.build_mesh(data=-1)
+    n = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
+    itemsize = jnp.dtype(dtype).itemsize
+    per_dev = max(size_bytes // itemsize, n)
+    per_dev -= per_dev % n  # divisible for scatter ops
+    ops: dict[str, Callable] = {
+        "allreduce": lambda x: jax.lax.psum(x, axis),
+        "allgather": lambda x: jax.lax.all_gather(x, axis, tiled=True),
+        "reduce_scatter": lambda x: jax.lax.psum_scatter(x, axis, tiled=True),
+        "all_to_all": lambda x: jax.lax.all_to_all(
+            x, axis, split_axis=0, concat_axis=0, tiled=True
+        ),
+        "ppermute": lambda x: ppermute_ring(x, axis),
+    }
+    fn = ops[op]
+    out_specs = {
+        "allreduce": P(axis),   # per-shard result, same shape as shard
+        "allgather": P(axis),   # every shard holds the full gather
+        "reduce_scatter": P(axis),
+        "all_to_all": P(axis),
+        "ppermute": P(axis),
+    }[op]
+
+    @partial(
+        shard_map, mesh=mesh, in_specs=P(axis), out_specs=out_specs,
+        check_rep=False,
+    )
+    def run(x):
+        return fn(x)
+
+    x = jnp.ones((per_dev * n,), dtype)
+    x = jax.device_put(x, NamedSharding(mesh, P(axis)))
+    jitted = jax.jit(run)
+    for _ in range(warmup):
+        jitted(x).block_until_ready()
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jitted(x).block_until_ready()
+        times.append(time.perf_counter() - t0)
+    t = statistics.median(times)
+    # NCCL-tests convention: bandwidth is computed from the PER-RANK buffer
+    # size, not the global array size.
+    nbytes = per_dev * itemsize
+    alg = nbytes / t / 1e9
+    return CollectiveBenchResult(
+        op=op,
+        n_devices=n,
+        size_bytes=nbytes,
+        time_s=t,
+        alg_bw_gbps=alg,
+        bus_bw_gbps=alg * _bus_factor(op, n),
+    )
+
+
+def bench_sweep(
+    sizes: Sequence[int] = (2**20, 2**24, 2**27),
+    ops: Sequence[str] = ("allreduce", "allgather", "reduce_scatter"),
+    **kwargs,
+) -> list[CollectiveBenchResult]:
+    return [bench_collective(op, s, **kwargs) for op in ops for s in sizes]

@@ -1,0 +1,396 @@
+"""Partition planner (component C2).
+
+Reference capability (SURVEY.md C2; BASELINE.json north star): inspect the
+model structure and device topology and emit a shard plan, automatically
+choosing between data-parallel, tensor-parallel and FSDP-style execution
+(BASELINE.json:8-11) so that a one-line ``AutoDistribute(model)`` runs
+unmodified.
+
+TPU-native realization: the plan is a ``jax.sharding.Mesh`` plus a pytree of
+``PartitionSpec`` — GSPMD then inserts all collectives.  The planner is a
+pure function ``(abstract params, mesh, policy) -> ShardPlan`` and is fully
+unit-testable without devices.
+
+Strategy catalogue (mirrors the reference's exercised configs):
+
+- ``dp``        replicate params, shard batch on ``data``  (DDP analog)
+- ``fsdp``      ZeRO-3: shard every param's largest divisible axis on the
+                ``fsdp`` mesh axis; optimizer state inherits the same specs
+- ``tp``        Megatron column/row splits on attention/MLP weights over the
+                ``tensor`` axis, chosen by name-pattern rules
+- ``tp_fsdp``   TP rules first, FSDP on what remains
+- ``auto``      pick one of the above from model size vs per-chip HBM and
+                mesh shape
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from typing import Any, Callable, Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import topology as topo_mod
+
+# ---------------------------------------------------------------------------
+# Sharding rules
+# ---------------------------------------------------------------------------
+
+Axis = str | tuple[str, ...] | None
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """Name-pattern sharding rule.
+
+    ``pattern`` is a regex searched against the '/'-joined parameter path
+    (e.g. ``"layers_3/attn/q_proj/kernel"``).  ``dim_axes`` assigns mesh
+    axes to the *trailing* dimensions of the parameter: the last
+    ``len(dim_axes)`` dims get the listed axes; leading dims are
+    unsharded.  First matching rule wins.
+    """
+
+    pattern: str
+    dim_axes: tuple[Axis, ...]
+
+    def matches(self, path: str) -> bool:
+        return re.search(self.pattern, path) is not None
+
+
+# Megatron-style transformer rules (SURVEY.md C5): column-split the
+# fan-out projections (QKV, MLP up/gate), row-split the fan-in
+# projections (attention out, MLP down).  Embeddings vocab-split.
+TRANSFORMER_RULES: tuple[Rule, ...] = (
+    Rule(r"(q_proj|k_proj|v_proj|qkv|query|key|value|wq|wk|wv)/kernel", (None, "tensor")),
+    Rule(r"(o_proj|out_proj|attn_out|wo|proj_out)/kernel", ("tensor", None)),
+    Rule(r"(up_proj|gate_proj|fc1|wi|w1|w3|mlp_in)/kernel", (None, "tensor")),
+    Rule(r"(down_proj|fc2|wo_mlp|w2|mlp_out)/kernel", ("tensor", None)),
+    Rule(r"(embed|embedding|wte|tok_embed)[^/]*/(embedding|kernel)", ("tensor", None)),
+    Rule(r"(lm_head|output_proj|unembed)/kernel", (None, "tensor")),
+    # biases of column-split layers follow the split output dim
+    Rule(r"(q_proj|k_proj|v_proj|qkv|up_proj|gate_proj|fc1|wi|w1|w3)/bias", ("tensor",)),
+    # norms / scalars replicated
+    Rule(r"(norm|ln|layernorm|rmsnorm|scale)", ()),
+)
+
+
+# ---------------------------------------------------------------------------
+# Plan
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ShardPlan:
+    """The planner's output: everything needed to jit a sharded step."""
+
+    mesh: Mesh
+    strategy: str
+    param_specs: Any  # pytree of PartitionSpec, same structure as params
+    batch_spec: P  # spec for the leading (batch) dim of inputs
+    remat: bool = False
+
+    def param_shardings(self) -> Any:
+        return jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s),
+            self.param_specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    def batch_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, self.batch_spec)
+
+    def describe(self) -> str:
+        lines = [f"ShardPlan(strategy={self.strategy}, mesh={dict(zip(self.mesh.axis_names, self.mesh.devices.shape))})"]
+        flat = _flatten_with_paths(self.param_specs)
+        for path, spec in flat:
+            lines.append(f"  {path}: {spec}")
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Helpers
+# ---------------------------------------------------------------------------
+
+
+def _flatten_with_paths(tree: Any) -> list[tuple[str, Any]]:
+    flat, _ = jax.tree_util.tree_flatten_with_path(
+        tree, is_leaf=lambda x: isinstance(x, P)
+    )
+    out = []
+    for keypath, leaf in flat:
+        out.append((path_str(keypath), leaf))
+    return out
+
+
+def path_str(keypath: Sequence[Any]) -> str:
+    parts = []
+    for k in keypath:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def _axis_size(axis: Axis, degrees: Mapping[str, int]) -> int:
+    if axis is None:
+        return 1
+    if isinstance(axis, tuple):
+        return math.prod(degrees.get(a, 1) for a in axis)
+    return degrees.get(axis, 1)
+
+
+def _norm_spec(dims: Sequence[Axis]) -> P:
+    """Drop trailing unsharded dims so P(None) == P() comparisons hold."""
+    dims = list(dims)
+    while dims and dims[-1] is None:
+        dims.pop()
+    return P(*dims)
+
+
+def _spec_from_rule(
+    rule: Rule, shape: tuple[int, ...], degrees: Mapping[str, int]
+) -> P | None:
+    """Build a PartitionSpec from a rule, or None if shapes don't divide."""
+    n = len(rule.dim_axes)
+    if n > len(shape):
+        return None
+    dims: list[Axis] = [None] * (len(shape) - n) + list(rule.dim_axes)
+    for d, ax in enumerate(dims):
+        size = _axis_size(ax, degrees)
+        if size > 1 and shape[d] % size != 0:
+            return None  # indivisible — caller falls back
+    return _norm_spec(dims)
+
+
+def _fsdp_spec(
+    shape: tuple[int, ...],
+    degrees: Mapping[str, int],
+    existing: P | None = None,
+    fsdp_axes: tuple[str, ...] = ("fsdp",),
+) -> P:
+    """Shard the largest still-unsharded, divisible dim over the fsdp axes.
+
+    ZeRO-3 pattern (SURVEY.md C6, PAPERS.md:5,7): parameters are stored
+    sharded and all-gathered on use by GSPMD; optimizer state inherits the
+    spec, giving ZeRO-1/2 for free.
+    """
+    size = math.prod(_axis_size(a, degrees) for a in fsdp_axes)
+    if size <= 1:
+        return existing or P()
+    used: list[Axis] = list(existing) if existing is not None else [None] * len(shape)
+    while len(used) < len(shape):
+        used.append(None)
+    # prefer the largest dim; tie-break on the first
+    order = sorted(range(len(shape)), key=lambda d: -shape[d])
+    for d in order:
+        if used[d] is None and shape[d] % size == 0:
+            used[d] = fsdp_axes if len(fsdp_axes) > 1 else fsdp_axes[0]
+            return _norm_spec(used)
+    return _norm_spec(used)  # nothing divisible — stays as-is
+
+
+# ---------------------------------------------------------------------------
+# Planner entry points
+# ---------------------------------------------------------------------------
+
+# Rough per-chip HBM capacities (bytes) by device kind substring.
+_HBM_BYTES = {
+    "v5 lite": 16 * 2**30,
+    "v5e": 16 * 2**30,
+    "v4": 32 * 2**30,
+    "v5p": 95 * 2**30,
+    "v6": 32 * 2**30,
+    "cpu": 8 * 2**30,
+}
+
+
+def _hbm_bytes(device_kind: str) -> int:
+    dk = device_kind.lower()
+    for k, v in _HBM_BYTES.items():
+        if k in dk:
+            return v
+    return 16 * 2**30
+
+
+def param_spec_tree(
+    abstract_params: Any,
+    mesh: Mesh,
+    strategy: str,
+    rules: Sequence[Rule] = TRANSFORMER_RULES,
+    fsdp_axes: tuple[str, ...] = ("fsdp",),
+) -> Any:
+    """Assign a PartitionSpec to every parameter by path+shape.
+
+    Pure function over abstract shapes — the unit-testable core (SURVEY.md
+    §7 phase 3).
+    """
+    degrees = topo_mod.mesh_degrees(mesh)
+    use_tp = strategy in ("tp", "tp_fsdp") and degrees.get("tensor", 1) > 1
+    use_fsdp = strategy in ("fsdp", "tp_fsdp") and _axis_size(fsdp_axes, degrees) > 1
+
+    def assign(keypath, leaf):
+        shape = tuple(getattr(leaf, "shape", ()))
+        path = path_str(keypath)
+        spec: P | None = None
+        if use_tp:
+            for rule in rules:
+                if rule.matches(path):
+                    spec = _spec_from_rule(rule, shape, degrees)
+                    break
+        if use_fsdp and len(shape) >= 1:
+            spec = _fsdp_spec(shape, degrees, existing=spec, fsdp_axes=fsdp_axes)
+        return spec if spec is not None else P()
+
+    return jax.tree_util.tree_map_with_path(assign, abstract_params)
+
+
+def batch_partition_spec(mesh: Mesh) -> P:
+    """Batch dim sharded over every data-carrying axis present in the mesh."""
+    degrees = topo_mod.mesh_degrees(mesh)
+    axes = tuple(a for a in ("data", "fsdp") if degrees.get(a, 1) > 1)
+    return P(axes) if axes else P(None)
+
+
+def tree_bytes(abstract_params: Any) -> int:
+    leaves = jax.tree.leaves(abstract_params)
+    total = 0
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", ())
+        dtype = np.dtype(getattr(leaf, "dtype", np.float32))
+        total += math.prod(shape) * dtype.itemsize if shape else dtype.itemsize
+    return total
+
+
+def choose_strategy(
+    abstract_params: Any,
+    topo: topo_mod.Topology,
+    rules: Sequence[Rule] = TRANSFORMER_RULES,
+) -> tuple[str, dict[str, int]]:
+    """Auto policy: pick (strategy, mesh axis degrees) from model size vs
+    HBM and whether TP rules apply to this model's parameter names.
+
+    Heuristics (documented, deliberately simple — SURVEY.md §7 'hard parts'
+    #1 says start rule-based and fail loudly):
+
+    - 1 device -> no-op DP (identity path, BASELINE.json:7)
+    - params + grads + adam state (~4x param bytes in fp32 master) fit in
+      60% of one chip's HBM -> plain DP (cheapest collectives)
+    - else if any TP rule matches and a tensor degree <= 8 divides the
+      device count -> tp_fsdp (TP inside, FSDP across)
+    - else -> FSDP over all devices
+    """
+    n = topo.num_devices
+    if n == 1:
+        return "dp", {"data": 1}
+    pbytes = tree_bytes(abstract_params)
+    train_state_bytes = 4 * pbytes  # params + grads + 2 adam moments
+    if train_state_bytes < 0.6 * _hbm_bytes(topo.device_kind):
+        return "dp", {"data": n}
+    paths = [p for p, _ in _flatten_with_paths(
+        jax.tree.map(lambda x: P(), abstract_params))]
+    # A rule makes the model "TP-applicable" only if it actually shards a
+    # dim on the tensor axis (replication/bias rules don't count).
+    tp_rules = [
+        r for r in rules
+        if any(
+            ax == "tensor" or (isinstance(ax, tuple) and "tensor" in ax)
+            for ax in r.dim_axes
+        )
+    ]
+    tp_applicable = any(r.matches(p) for p in paths for r in tp_rules)
+    if tp_applicable:
+        for t in (8, 4, 2):
+            if n % t == 0 and t <= n:
+                return "tp_fsdp", {"fsdp": n // t, "tensor": t}
+    return "fsdp", {"fsdp": n}
+
+
+def make_plan(
+    abstract_params: Any,
+    *,
+    mesh: Mesh | None = None,
+    strategy: str = "auto",
+    rules: Sequence[Rule] = TRANSFORMER_RULES,
+    devices: Sequence[jax.Device] | None = None,
+    remat: bool | None = None,
+) -> ShardPlan:
+    """The planner: abstract params + topology -> ShardPlan.
+
+    ``abstract_params`` is any pytree of objects with ``.shape``/``.dtype``
+    (e.g. the output of ``jax.eval_shape``).  If ``mesh`` is given the
+    strategy is applied on it as-is; otherwise the mesh is built from the
+    chosen/requested strategy.
+    """
+    known = ("auto", "dp", "fsdp", "tp", "tp_fsdp")
+    if strategy not in known:
+        raise ValueError(f"Unknown strategy {strategy!r}; expected one of {known}")
+    topo = topo_mod.detect(devices)
+    resolved = strategy
+    if mesh is None:
+        if strategy == "auto":
+            resolved, degrees = choose_strategy(abstract_params, topo, rules)
+        elif strategy == "dp":
+            degrees = {"data": topo.num_devices}
+        elif strategy == "fsdp":
+            degrees = {"fsdp": topo.num_devices}
+        elif strategy == "tp":
+            degrees = {"tensor": topo.num_devices}
+        elif strategy == "tp_fsdp":
+            n = topo.num_devices
+            t = min(8, n)
+            while n % t:
+                t //= 2
+            # keep both axes nontrivial when possible (8 devs -> 4x2 not 8x1)
+            while t > 2 and n // t < 2:
+                t //= 2
+            degrees = {"fsdp": n // t, "tensor": t}
+        else:
+            raise ValueError(f"Unknown strategy {strategy!r}")
+        mesh = topo_mod.build_mesh(devices=devices, **degrees)
+    elif strategy == "auto":
+        d = topo_mod.mesh_degrees(mesh)
+        if d.get("tensor", 1) > 1 and d.get("fsdp", 1) > 1:
+            resolved = "tp_fsdp"
+        elif d.get("tensor", 1) > 1:
+            resolved = "tp"
+        elif d.get("fsdp", 1) > 1:
+            resolved = "fsdp"
+        else:
+            resolved = "dp"
+
+    param_specs = param_spec_tree(abstract_params, mesh, resolved, rules)
+    degrees_final = topo_mod.mesh_degrees(mesh)
+    if resolved in ("tp", "tp_fsdp") and degrees_final.get("tensor", 1) > 1:
+        sharded = any(
+            "tensor" in (ax for dim in spec for ax in
+                         (dim if isinstance(dim, tuple) else (dim,)) if ax)
+            for _, spec in _flatten_with_paths(param_specs)
+        )
+        if not sharded:
+            import warnings
+
+            warnings.warn(
+                f"Strategy {resolved!r} requested a tensor axis of "
+                f"{degrees_final['tensor']} but no parameter matched any TP "
+                "rule — the model will run unsharded on that axis. Pass "
+                "custom rules= matching your parameter names.",
+                stacklevel=2,
+            )
+    if remat is None:
+        remat = resolved in ("fsdp", "tp_fsdp")
+    return ShardPlan(
+        mesh=mesh,
+        strategy=resolved,
+        param_specs=param_specs,
+        batch_spec=batch_partition_spec(mesh),
+        remat=remat,
+    )

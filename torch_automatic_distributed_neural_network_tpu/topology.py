@@ -1,0 +1,250 @@
+"""Topology discovery and device-mesh construction (component C10).
+
+Reference capability (SURVEY.md C10; BASELINE.json north star): the reference
+enumerates CUDA devices (``torch.cuda.device_count``) and the TPU-native
+version must "learn TPU pod mesh topology (v4/v5 ICI rings)".
+
+TPU-native realization: ``jax.devices()`` + ``mesh_utils.create_device_mesh``
+(which is ICI-topology-aware on real TPU slices) and
+``create_hybrid_device_mesh`` for multi-slice (ICI x DCN) deployments.
+
+The canonical mesh axes used throughout the framework:
+
+=========  =======================================================
+axis       used by
+=========  =======================================================
+``data``   data parallelism (batch sharding; DDP/bucketed-DDP analog)
+``fsdp``   ZeRO-3 parameter/optimizer sharding (can alias ``data``)
+``tensor`` Megatron-style tensor parallelism (col/row weight splits)
+``seq``    sequence / context parallelism (ring attention, Ulysses)
+``pipe``   pipeline parallelism (stage meshes)
+``expert`` expert parallelism (MoE all_to_all dispatch)
+=========  =======================================================
+
+Axes are ordered slowest-varying first so that axes that carry the most
+traffic (``tensor``, ``seq``) land on the fastest (innermost ICI) links,
+and ``data`` — which only carries one gradient allreduce per step — can be
+placed across DCN on hybrid meshes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+# Canonical axis ordering: outermost (slowest links OK) -> innermost
+# (fastest links required).  DCN-friendly axes first.
+MESH_AXES: tuple[str, ...] = ("pipe", "data", "fsdp", "expert", "seq", "tensor")
+
+# Axes whose collectives are latency/bandwidth critical and must ride ICI.
+ICI_AXES: frozenset[str] = frozenset({"tensor", "seq", "expert", "fsdp"})
+# Axes that tolerate DCN (one collective per step, overlappable).
+DCN_OK_AXES: tuple[str, ...] = ("pipe", "data")
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """A snapshot of the accelerator topology visible to this process."""
+
+    num_devices: int
+    num_hosts: int
+    platform: str  # 'tpu' | 'cpu' | 'gpu' | 'axon' ...
+    device_kind: str
+    num_slices: int = 1
+    devices_per_slice: int | None = None
+
+    @property
+    def is_multihost(self) -> bool:
+        return self.num_hosts > 1
+
+    @property
+    def is_multislice(self) -> bool:
+        return self.num_slices > 1
+
+
+def detect(devices: Sequence[jax.Device] | None = None) -> Topology:
+    """Discover the visible device topology.
+
+    Equivalent of the reference's CUDA device enumeration, but also derives
+    slice structure (for DCN-aware hybrid meshes) from device attributes.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    slice_ids = {getattr(d, "slice_index", 0) or 0 for d in devices}
+    num_slices = max(len(slice_ids), 1)
+    return Topology(
+        num_devices=len(devices),
+        num_hosts=max(len({d.process_index for d in devices}), 1),
+        platform=devices[0].platform if devices else "cpu",
+        device_kind=devices[0].device_kind if devices else "unknown",
+        num_slices=num_slices,
+        devices_per_slice=len(devices) // num_slices if devices else None,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    """Resolved per-axis parallelism degrees for a mesh build."""
+
+    axes: Mapping[str, int]
+
+    def degree(self, axis: str) -> int:
+        return int(self.axes.get(axis, 1))
+
+    @property
+    def total(self) -> int:
+        return math.prod(self.axes.values()) if self.axes else 1
+
+
+def _resolve_degrees(
+    num_devices: int, requested: Mapping[str, int | None]
+) -> dict[str, int]:
+    """Fill in unspecified (-1/None) axis degrees so the product covers all
+    devices.  At most one axis may be -1; unmentioned axes get 1; if nothing
+    is specified, everything goes to ``data``."""
+    degrees: dict[str, int] = {}
+    infer_axis: str | None = None
+    for ax in MESH_AXES:
+        v = requested.get(ax)
+        if v in (-1, None) and ax in requested:
+            if infer_axis is not None:
+                raise ValueError(
+                    f"At most one mesh axis may be -1 (got {infer_axis!r} and {ax!r})"
+                )
+            infer_axis = ax
+        elif v is not None:
+            if v < 1:
+                raise ValueError(f"Axis {ax!r} degree must be >=1 or -1, got {v}")
+            degrees[ax] = int(v)
+    specified = math.prod(degrees.values()) if degrees else 1
+    if infer_axis is not None:
+        if num_devices % specified:
+            raise ValueError(
+                f"{num_devices} devices not divisible by specified axes product "
+                f"{specified} ({degrees})"
+            )
+        degrees[infer_axis] = num_devices // specified
+    elif not degrees:
+        degrees["data"] = num_devices
+    else:
+        if specified != num_devices:
+            # Auto-expand the data axis to absorb remaining devices.
+            if num_devices % specified:
+                raise ValueError(
+                    f"Mesh axes {degrees} (product {specified}) do not divide "
+                    f"{num_devices} devices"
+                )
+            degrees["data"] = degrees.get("data", 1) * (num_devices // specified)
+    full = {ax: degrees.get(ax, 1) for ax in MESH_AXES}
+    assert math.prod(full.values()) == num_devices
+    return full
+
+
+def build_mesh(
+    *,
+    data: int | None = None,
+    fsdp: int | None = None,
+    tensor: int | None = None,
+    seq: int | None = None,
+    pipe: int | None = None,
+    expert: int | None = None,
+    devices: Sequence[jax.Device] | None = None,
+    allow_split_physical_axes: bool = False,
+) -> Mesh:
+    """Build an ICI-aware ``jax.sharding.Mesh`` over the visible devices.
+
+    Unspecified axes default to 1; pass ``-1`` for exactly one axis to infer
+    its degree from the device count; with no axes specified all devices go
+    to ``data`` (pure DP — the reference's DDP default, BASELINE.json:8).
+
+    On real TPU slices ``mesh_utils.create_device_mesh`` orders devices so
+    each mesh axis maps onto ICI rings; on multi-slice topologies a hybrid
+    ICI x DCN mesh is built with DCN-tolerant axes (``pipe``, ``data``)
+    spanning slices.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    topo = detect(devices)
+    requested = {
+        "data": data,
+        "fsdp": fsdp,
+        "tensor": tensor,
+        "seq": seq,
+        "pipe": pipe,
+        "expert": expert,
+    }
+    requested = {k: v for k, v in requested.items() if v is not None}
+    degrees = _resolve_degrees(len(devices), requested)
+    shape = tuple(degrees[ax] for ax in MESH_AXES)
+
+    if topo.is_multislice and topo.devices_per_slice:
+        # Hybrid mesh: DCN-tolerant axes across slices, the rest within.
+        per_slice = topo.devices_per_slice
+        dcn_shape = []
+        ici_shape = []
+        remaining_dcn = topo.num_slices
+        for ax in MESH_AXES:
+            d = degrees[ax]
+            if ax in DCN_OK_AXES and remaining_dcn > 1 and d % remaining_dcn == 0:
+                dcn_shape.append(remaining_dcn)
+                ici_shape.append(d // remaining_dcn)
+                remaining_dcn = 1
+            else:
+                dcn_shape.append(1)
+                ici_shape.append(d)
+        if remaining_dcn == 1 and math.prod(ici_shape) == per_slice * 1:
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                ici_shape,
+                dcn_shape,
+                devices=devices,
+                allow_split_physical_axes=allow_split_physical_axes,
+            )
+            return Mesh(dev_array, MESH_AXES)
+        # Fall through to flat mesh if the factorization failed.
+
+    try:
+        dev_array = mesh_utils.create_device_mesh(
+            shape, devices=devices,
+            allow_split_physical_axes=allow_split_physical_axes,
+        )
+    except (ValueError, NotImplementedError, AssertionError):
+        # CPU sim / odd topologies: plain row-major reshape is always valid.
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, MESH_AXES)
+
+
+def single_device_mesh(device: jax.Device | None = None) -> Mesh:
+    """Trivial 1-device mesh — the no-op path (BASELINE.json:7)."""
+    device = device or jax.devices()[0]
+    return Mesh(
+        np.asarray([device]).reshape((1,) * len(MESH_AXES)), MESH_AXES
+    )
+
+
+def mesh_degrees(mesh: Mesh) -> dict[str, int]:
+    return {ax: int(n) for ax, n in zip(mesh.axis_names, mesh.devices.shape)}
+
+
+def initialize_distributed(**kwargs) -> None:
+    """Multi-host runtime init — the ``torchrun``/``mp.spawn`` analog (C9).
+
+    Single-controller JAX needs no per-device spawn; on multi-host
+    deployments each host calls this once (coordinator discovered from
+    env or explicit kwargs).  No-op when single-process.
+    """
+    coord = kwargs.get("coordinator_address") or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if not coord and "num_processes" not in kwargs:
+        return  # single-process launch — nothing to initialize
+    try:
+        jax.distributed.initialize(**kwargs)
+    except RuntimeError as e:
+        if "already" in str(e).lower():
+            return  # idempotent: a second call is a no-op
+        raise

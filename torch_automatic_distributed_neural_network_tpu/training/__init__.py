@@ -1,0 +1,5 @@
+"""Training-loop subsystems: optimizer sharding, losses, metrics, checkpoints."""
+
+from .losses import softmax_xent_loss, next_token_loss, mse_loss
+
+__all__ = ["softmax_xent_loss", "next_token_loss", "mse_loss"]

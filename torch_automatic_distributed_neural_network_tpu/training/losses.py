@@ -1,0 +1,48 @@
+"""Standard loss functions with the AutoDistribute loss_fn signature.
+
+``loss_fn(params, batch, rng, apply_fn) -> (loss, aux_dict)``.
+Batches are dicts; classification expects ``x``/``label``, LM expects
+``input_ids`` (next-token target derived by shifting).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def softmax_xent_loss(params, batch, rng, apply_fn):
+    """Image/sequence classification: logits vs integer labels."""
+    x = batch.get("x", batch.get("image"))
+    labels = batch.get("label", batch.get("y"))
+    logits = apply_fn(params, x, rngs={"dropout": rng} if rng is not None else None)
+    loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+    acc = jnp.mean(jnp.argmax(logits, -1) == labels)
+    return loss, {"accuracy": acc}
+
+
+def next_token_loss(params, batch, rng, apply_fn):
+    """Causal LM: predict token t+1 from tokens <= t; ignores padding 0s
+    if an explicit ``mask`` is present."""
+    tokens = batch.get("input_ids", batch.get("tokens"))
+    logits = apply_fn(params, tokens[:, :-1])
+    targets = tokens[:, 1:]
+    losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    mask = batch.get("mask")
+    if mask is not None:
+        mask = mask[:, 1:]
+        loss = (losses * mask).sum() / jnp.maximum(mask.sum(), 1)
+        denom = jnp.maximum(mask.sum(), 1)
+    else:
+        loss = losses.mean()
+        denom = jnp.asarray(targets.size, jnp.float32)
+    return loss, {"tokens": denom}
+
+
+def mse_loss(params, batch, rng, apply_fn):
+    x = batch.get("x")
+    y = batch.get("y", batch.get("label"))
+    pred = apply_fn(params, x)
+    loss = jnp.mean((pred - y) ** 2)
+    return loss, {}

@@ -1,0 +1,68 @@
+"""Optimizer integration (component C14).
+
+The reference uses stock ``torch.optim`` on sharded params (SURVEY.md C14).
+TPU-native: optax transforms; optimizer state *inherits* the parameter
+PartitionSpecs, which makes ZeRO-1/2 fall out of the FSDP specs for free
+(SURVEY.md C6/C14, PAPERS.md:5 weight-update sharding).
+
+The one nontrivial piece is mapping param specs onto the optax state pytree,
+whose structure differs from the param tree (e.g. ``ScaleByAdamState(count,
+mu, nu)`` where ``mu``/``nu`` each mirror the param tree).  We match each
+optimizer-state leaf to a parameter by (path-suffix, shape); scalars and
+unmatched leaves are replicated.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..planner import path_str
+
+
+def _leaf_shape(x) -> tuple[int, ...]:
+    return tuple(getattr(x, "shape", ()))
+
+
+def opt_state_spec_tree(
+    abstract_opt_state: Any, abstract_params: Any, param_specs: Any
+) -> Any:
+    """PartitionSpec pytree for an optax state, inherited from param specs.
+
+    For every array leaf in the optimizer state, find a parameter whose
+    '/'-joined path is a suffix of the leaf's path and whose shape matches;
+    use that parameter's spec.  Scalars (shape ()) and unmatched leaves get
+    ``P()`` (replicated) — correct for step counters and schedules.
+    """
+    params_flat, _ = jax.tree_util.tree_flatten_with_path(abstract_params)
+    specs_flat = jax.tree.leaves(
+        param_specs, is_leaf=lambda x: isinstance(x, P)
+    )
+    by_path: dict[str, tuple[tuple[int, ...], P]] = {}
+    by_shape: dict[tuple[int, ...], P] = {}
+    for (kp, leaf), spec in zip(params_flat, specs_flat):
+        p = path_str(kp)
+        by_path[p] = (_leaf_shape(leaf), spec)
+        by_shape.setdefault(_leaf_shape(leaf), spec)
+
+    def assign(kp, leaf):
+        shape = _leaf_shape(leaf)
+        if not shape:
+            return P()
+        path = path_str(kp)
+        # longest-suffix match against param paths
+        best: P | None = None
+        best_len = -1
+        for ppath, (pshape, spec) in by_path.items():
+            if pshape == shape and (path.endswith(ppath) or ppath.endswith(path)):
+                if len(ppath) > best_len:
+                    best, best_len = spec, len(ppath)
+        if best is not None:
+            return best
+        # fall back to unique-shape match (covers renamed inner trees)
+        return by_shape.get(shape, P())
+
+    return jax.tree_util.tree_map_with_path(assign, abstract_opt_state)

@@ -1,0 +1,1 @@
+"""Config, profiling, debug-guard utilities."""
