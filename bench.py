@@ -1,0 +1,184 @@
+"""Headline benchmark for the driver: GPT-2 tokens/sec/chip on real hardware.
+
+Prints ONE JSON line to stdout:
+    {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+
+The reference publishes no numbers (BASELINE.md): ``vs_baseline`` is
+measured MFU / the 40%-MFU north-star target (BASELINE.json:5), so 1.0
+means "hit the target".  Everything else goes to stderr.
+
+Flags (key=value):
+    model=medium|small|large|1p3b   seq=1024  batch=8  steps=20  strategy=auto
+    mode=gpt2|resnet|collectives
+"""
+
+import json
+import statistics
+import sys
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def parse_args():
+    args = {
+        "model": "medium", "seq": 1024, "batch": 8, "steps": 20,
+        "strategy": "auto", "mode": "gpt2",
+    }
+    for item in sys.argv[1:]:
+        k, _, v = item.partition("=")
+        args[k] = int(v) if v.isdigit() else v
+    return args
+
+
+def bench_gpt2(args):
+    import jax
+    import optax
+
+    import torch_automatic_distributed_neural_network_tpu as tad
+    from torch_automatic_distributed_neural_network_tpu.data.synthetic import (
+        SyntheticLM,
+    )
+    from torch_automatic_distributed_neural_network_tpu.models import (
+        GPT2,
+        gpt2_config,
+    )
+    from torch_automatic_distributed_neural_network_tpu.training import (
+        next_token_loss,
+        peak_flops_per_chip,
+        transformer_step_flops,
+    )
+
+    seq, batch, steps = args["seq"], args["batch"], args["steps"]
+    mcfg = gpt2_config(args["model"], max_seq_len=seq)
+    log(f"bench: GPT-2 {args['model']} ({mcfg.num_params()/1e6:.0f}M params) "
+        f"seq={seq} batch={batch} on {jax.device_count()} x "
+        f"{jax.devices()[0].device_kind}")
+
+    data = SyntheticLM(vocab_size=mcfg.vocab_size, seq_len=seq + 1,
+                       batch_size=batch)
+    ad = tad.AutoDistribute(
+        GPT2(args["model"], max_seq_len=seq),
+        optimizer=optax.adamw(1e-4),
+        loss_fn=next_token_loss,
+        strategy=args["strategy"],
+    )
+    t0 = time.perf_counter()
+    state = ad.init(jax.random.key(0), data.batch(0))
+    b = data.batch(0)
+    state, _ = ad.step(state, b)  # compile
+    jax.block_until_ready(state.params)
+    log(f"compile+init: {time.perf_counter()-t0:.1f}s "
+        f"plan={ad.plan.strategy} mesh={tad.mesh_degrees(ad.plan.mesh)}")
+
+    # warmup
+    for i in range(2):
+        state, _ = ad.step(state, data.batch(i))
+    jax.block_until_ready(state.params)
+
+    times = []
+    batches = [data.batch(i) for i in range(steps)]
+    for b in batches:
+        t = time.perf_counter()
+        state, _ = ad.step(state, b)
+        jax.block_until_ready(state.step)
+        times.append(time.perf_counter() - t)
+    dt = statistics.median(times)
+    n_chips = jax.device_count()
+    tokens_per_step = batch * seq
+    tps_chip = tokens_per_step / dt / n_chips
+    flops_mult = 8.0 / 6.0 if ad.plan.remat else 1.0
+    flops = transformer_step_flops(mcfg.num_params(), tokens_per_step) * flops_mult
+    mfu = flops / dt / (peak_flops_per_chip() * n_chips)
+    log(f"median step {dt*1e3:.1f}ms  {tps_chip:,.0f} tokens/s/chip  "
+        f"MFU {mfu:.1%} (remat={'on' if ad.plan.remat else 'off'})")
+    return {
+        "metric": f"gpt2_{args['model']}_tokens_per_sec_per_chip",
+        "value": round(tps_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "extra": {
+            "mfu": round(mfu, 4),
+            "step_time_ms": round(dt * 1e3, 2),
+            "seq": seq,
+            "batch": batch,
+            "params_m": round(mcfg.num_params() / 1e6),
+            "n_chips": n_chips,
+            "strategy": ad.plan.strategy,
+        },
+    }
+
+
+def bench_resnet(args):
+    import jax
+    import optax
+
+    import torch_automatic_distributed_neural_network_tpu as tad
+    from torch_automatic_distributed_neural_network_tpu.data.synthetic import (
+        SyntheticClassification,
+    )
+    from torch_automatic_distributed_neural_network_tpu.models import ResNet50
+    from torch_automatic_distributed_neural_network_tpu.training import (
+        softmax_xent_loss_mutable,
+    )
+
+    batch, steps = args["batch"] * 16, args["steps"]
+    data = SyntheticClassification(image_shape=(224, 224, 3), num_classes=1000,
+                                   batch_size=batch)
+    ad = tad.AutoDistribute(
+        ResNet50(num_classes=1000),
+        optimizer=optax.sgd(0.1, momentum=0.9),
+        loss_fn=softmax_xent_loss_mutable,
+        strategy="dp",
+    )
+    state = ad.init(jax.random.key(0), data.batch(0))
+    state, _ = ad.step(state, data.batch(0))
+    jax.block_until_ready(state.step)
+    times = []
+    batches = [data.batch(i) for i in range(steps)]
+    for b in batches:
+        t = time.perf_counter()
+        state, _ = ad.step(state, b)
+        jax.block_until_ready(state.step)
+        times.append(time.perf_counter() - t)
+    dt = statistics.median(times)
+    ips_chip = batch / dt / jax.device_count()
+    log(f"median step {dt*1e3:.1f}ms  {ips_chip:,.0f} images/s/chip")
+    return {
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(ips_chip, 1),
+        "unit": "images/s/chip",
+        "vs_baseline": 0.0,
+        "extra": {"batch": batch, "step_time_ms": round(dt * 1e3, 2)},
+    }
+
+
+def bench_collectives(args):
+    from torch_automatic_distributed_neural_network_tpu.parallel.collectives import (
+        bench_collective,
+    )
+
+    r = bench_collective("allreduce", size_bytes=64 * 2**20, axis="data")
+    log(f"allreduce 64MiB/rank on {r.n_devices} devices: "
+        f"bus {r.bus_bw_gbps:.1f} GB/s")
+    return {
+        "metric": "allreduce_bus_bandwidth",
+        "value": round(r.bus_bw_gbps, 2),
+        "unit": "GB/s",
+        "vs_baseline": 0.0,
+        "extra": r.to_json(),
+    }
+
+
+def main():
+    args = parse_args()
+    fn = {"gpt2": bench_gpt2, "resnet": bench_resnet,
+          "collectives": bench_collectives}[args["mode"]]
+    result = fn(args)
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

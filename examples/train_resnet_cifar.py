@@ -1,0 +1,103 @@
+"""Train ResNet-50 on (synthetic) CIFAR-10, data-parallel.
+
+The reference's second example config (BASELINE.json:8): "ResNet-50 /
+CIFAR-10 data-parallel (DDP allreduce -> XLA allreduce)".  Headline metric:
+images/sec/chip.
+
+Usage::
+
+    python examples/train_resnet_cifar.py run.steps=100 run.batch_size=256
+    python examples/train_resnet_cifar.py model.arch=thin   # CPU-sim scale
+"""
+
+import dataclasses
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import optax
+
+import torch_automatic_distributed_neural_network_tpu as tad
+from torch_automatic_distributed_neural_network_tpu.data.synthetic import (
+    SyntheticClassification,
+)
+from torch_automatic_distributed_neural_network_tpu.models import (
+    ResNet18Thin,
+    ResNet50,
+)
+from torch_automatic_distributed_neural_network_tpu.training import (
+    MetricsLogger,
+    Trainer,
+    TrainerConfig,
+    softmax_xent_loss_mutable,
+)
+from torch_automatic_distributed_neural_network_tpu.utils import config as cfglib
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelCfg:
+    arch: str = "resnet50"  # resnet50 | thin
+
+
+@dataclasses.dataclass(frozen=True)
+class RunCfg:
+    steps: int = 50
+    batch_size: int = 128
+    lr: float = 0.1
+    log_every: int = 10
+    metrics_path: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelCfg:
+    strategy: str = "dp"
+
+
+@dataclasses.dataclass(frozen=True)
+class Cfg:
+    model: ModelCfg = ModelCfg()
+    run: RunCfg = RunCfg()
+    parallel: ParallelCfg = ParallelCfg()
+
+
+def main():
+    cfg: Cfg = cfglib.apply_overrides(Cfg(), sys.argv[1:])
+    print(cfglib.to_json(cfg))
+    print(f"devices: {jax.device_count()} x {jax.devices()[0].device_kind}")
+
+    if cfg.model.arch == "thin":
+        model = ResNet18Thin(num_classes=10)
+        image_shape = (16, 16, 3)
+    else:
+        model = ResNet50(num_classes=10, small_inputs=True)
+        image_shape = (32, 32, 3)
+    data = SyntheticClassification(
+        image_shape=image_shape, num_classes=10,
+        batch_size=cfg.run.batch_size,
+    )
+    ad = tad.AutoDistribute(
+        model,
+        optimizer=optax.sgd(cfg.run.lr, momentum=0.9),
+        loss_fn=softmax_xent_loss_mutable,
+        strategy=cfg.parallel.strategy,
+    )
+    metrics = MetricsLogger(
+        cfg.run.metrics_path or None,
+        items_name="images",
+        console_every=cfg.run.log_every,
+    )
+    trainer = Trainer(
+        ad,
+        TrainerConfig(steps=cfg.run.steps, log_every=cfg.run.log_every),
+        metrics=metrics,
+        items_per_step=cfg.run.batch_size,
+        run_config=cfglib.to_dict(cfg),
+    )
+    trainer.fit(iter(data))
+    print(f"plan: {ad.plan.strategy} mesh={tad.mesh_degrees(ad.plan.mesh)}")
+
+
+if __name__ == "__main__":
+    main()

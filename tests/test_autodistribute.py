@@ -111,7 +111,7 @@ def test_tp_matches_single_device(devices8):
     losses_1, _ = train_losses(make_ad("dp", devices=[jax.devices()[0]]))
     losses_8, state = train_losses(make_ad("tp", rules=rules))
     np.testing.assert_allclose(losses_1, losses_8, rtol=1e-5)
-    k0 = state.params["params"]["dense_0"]["kernel"]
+    k0 = state.params["dense_0"]["kernel"]
     assert not k0.sharding.is_fully_replicated
 
 

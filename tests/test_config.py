@@ -1,0 +1,53 @@
+"""Config system tests (SURVEY.md §5)."""
+
+import dataclasses
+
+import pytest
+
+from torch_automatic_distributed_neural_network_tpu.utils import config as cfglib
+
+
+@dataclasses.dataclass(frozen=True)
+class Inner:
+    d_model: int = 64
+    name: str = "x"
+
+
+@dataclasses.dataclass(frozen=True)
+class Outer:
+    model: Inner = Inner()
+    steps: int = 10
+    lr: float = 1e-3
+
+
+def test_overrides():
+    cfg = cfglib.apply_overrides(
+        Outer(), ["model.d_model=128", "steps=99", "lr=0.5", "model.name=gpt"]
+    )
+    assert cfg.model.d_model == 128
+    assert cfg.steps == 99
+    assert cfg.lr == 0.5
+    assert cfg.model.name == "gpt"
+
+
+def test_unknown_key_raises():
+    with pytest.raises(KeyError) as e:
+        cfglib.apply_overrides(Outer(), ["model.bogus=1"])
+    assert "d_model" in str(e.value)  # error lists valid keys
+
+
+def test_not_keyvalue_raises():
+    with pytest.raises(ValueError):
+        cfglib.apply_overrides(Outer(), ["steps"])
+
+
+def test_roundtrip_dict():
+    d = cfglib.to_dict(Outer())
+    assert d == {"model": {"d_model": 64, "name": "x"}, "steps": 10,
+                 "lr": 1e-3}
+
+
+def test_original_unchanged():
+    base = Outer()
+    cfglib.apply_overrides(base, ["steps=5"])
+    assert base.steps == 10

@@ -1,0 +1,199 @@
+"""Model-zoo training tests (components C11/C12): every model family trains
+end-to-end under AutoDistribute on the 8-device CPU sim, and parallel
+configs reproduce the single-device loss trajectory."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import torch_automatic_distributed_neural_network_tpu as tad
+from torch_automatic_distributed_neural_network_tpu.data.synthetic import (
+    SyntheticClassification,
+    SyntheticLM,
+    SyntheticSeq2Seq,
+)
+from torch_automatic_distributed_neural_network_tpu.models import (
+    GPT2,
+    Llama,
+    ResNet18Thin,
+    TransformerMT,
+)
+from torch_automatic_distributed_neural_network_tpu.training import (
+    next_token_loss,
+    seq2seq_loss,
+    softmax_xent_loss_mutable,
+)
+
+STEPS = 3
+
+
+def run(model, loss_fn, data, strategy, devices=None, **kw):
+    ad = tad.AutoDistribute(
+        model, optimizer=optax.adam(1e-3), loss_fn=loss_fn,
+        strategy=strategy, devices=devices, **kw,
+    )
+    state = ad.init(jax.random.key(0), data.batch(0))
+    losses = []
+    for i in range(STEPS):
+        state, m = ad.step(state, data.batch(i))
+        losses.append(float(m["loss"]))
+    return losses, state, ad
+
+
+@pytest.fixture(scope="module")
+def one_dev():
+    return [jax.devices()[0]]
+
+
+# -- GPT-2 ------------------------------------------------------------------
+
+
+def gpt2_model():
+    return GPT2("test", vocab_size=512, max_seq_len=64, dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def lm_data():
+    return SyntheticLM(vocab_size=512, seq_len=64, batch_size=8)
+
+
+def test_gpt2_dp_parity(devices8, one_dev, lm_data):
+    l1, _, _ = run(gpt2_model(), next_token_loss, lm_data, "dp", devices=one_dev)
+    l8, _, _ = run(gpt2_model(), next_token_loss, lm_data, "dp")
+    assert all(np.isfinite(l1)) and l1[-1] < l1[0]
+    np.testing.assert_allclose(l1, l8, rtol=2e-4)
+
+
+def test_gpt2_tp_parity(devices8, one_dev, lm_data):
+    l1, _, _ = run(gpt2_model(), next_token_loss, lm_data, "dp", devices=one_dev)
+    l8, state, ad = run(gpt2_model(), next_token_loss, lm_data, "tp")
+    np.testing.assert_allclose(l1, l8, rtol=2e-4)
+    # scanned q_proj kernel [layers, d, heads, hd]: sharded on heads axis
+    qk = state.params["layers"]["attn"]["q_proj"]["kernel"]
+    assert not qk.sharding.is_fully_replicated
+
+
+def test_gpt2_tp_fsdp_parity(devices8, one_dev, lm_data):
+    l1, _, _ = run(gpt2_model(), next_token_loss, lm_data, "dp", devices=one_dev)
+    l8, _, _ = run(gpt2_model(), next_token_loss, lm_data, "tp_fsdp")
+    np.testing.assert_allclose(l1, l8, rtol=2e-4)
+
+
+# -- Llama ------------------------------------------------------------------
+
+
+def llama_model():
+    return Llama("test", dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def llama_data():
+    return SyntheticLM(vocab_size=1024, seq_len=64, batch_size=8)
+
+
+def test_llama_fsdp_parity(devices8, one_dev, llama_data):
+    l1, _, _ = run(llama_model(), next_token_loss, llama_data, "dp",
+                   devices=one_dev)
+    l8, state, _ = run(llama_model(), next_token_loss, llama_data, "fsdp")
+    assert all(np.isfinite(l1))
+    np.testing.assert_allclose(l1, l8, rtol=2e-4)
+    shardings = [p.sharding for p in jax.tree.leaves(state.params)]
+    assert any(not s.is_fully_replicated for s in shardings)
+
+
+def test_llama_gqa_shapes(devices8, llama_data):
+    model = llama_model()
+    vars_ = model.init(jax.random.key(0), llama_data.batch(0)["input_ids"][:, :-1])
+    k = vars_["params"]["layers"]["attn"]["k_proj"]["kernel"]
+    q = vars_["params"]["layers"]["attn"]["q_proj"]["kernel"]
+    assert k.shape[-2] * 2 == q.shape[-2]  # 2 kv heads vs 4 query heads
+
+
+# -- ResNet (stateful BatchNorm) -------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def img_data():
+    return SyntheticClassification(
+        image_shape=(16, 16, 3), num_classes=10, batch_size=16
+    )
+
+
+def resnet_model():
+    return ResNet18Thin(dtype=jnp.float32)
+
+
+def test_resnet_dp_parity(devices8, one_dev, img_data):
+    l1, s1, _ = run(resnet_model(), softmax_xent_loss_mutable, img_data,
+                    "dp", devices=one_dev)
+    l8, s8, _ = run(resnet_model(), softmax_xent_loss_mutable, img_data, "dp")
+    assert all(np.isfinite(l1))
+    # GSPMD computes BatchNorm over the global batch -> exact SyncBN parity
+    np.testing.assert_allclose(l1, l8, rtol=2e-4)
+    bs1 = jax.tree.leaves(s1.model_state)
+    bs8 = jax.tree.leaves(s8.model_state)
+    for a, b in zip(bs1, bs8):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3,
+                                   atol=1e-5)
+
+
+def test_resnet_batchnorm_stats_update(devices8, img_data):
+    _, state, _ = run(resnet_model(), softmax_xent_loss_mutable, img_data, "dp")
+    means = [np.asarray(x) for x in jax.tree.leaves(
+        state.model_state["batch_stats"])]
+    assert any(np.abs(m).sum() > 0 for m in means)
+
+
+def test_resnet_eval_forward(devices8, img_data):
+    _, state, ad = run(resnet_model(), softmax_xent_loss_mutable, img_data, "dp")
+    logits = ad(state, img_data.batch(0)["x"], train=False)
+    assert logits.shape == (16, 10)
+
+
+# -- MT transformer ---------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def mt_data():
+    return SyntheticSeq2Seq(vocab_size=512, src_len=16, tgt_len=16,
+                            batch_size=8)
+
+
+def test_mt_dp_parity(devices8, one_dev, mt_data):
+    model = TransformerMT("test", dtype=jnp.float32)
+    l1, _, _ = run(model, seq2seq_loss, mt_data, "dp", devices=one_dev)
+    l8, _, _ = run(model, seq2seq_loss, mt_data, "dp")
+    assert all(np.isfinite(l1))
+    np.testing.assert_allclose(l1, l8, rtol=2e-4)
+
+
+def test_mt_tp_runs(devices8, mt_data):
+    model = TransformerMT("test", dtype=jnp.float32)
+    l8, state, _ = run(model, seq2seq_loss, mt_data, "tp")
+    assert all(np.isfinite(l8))
+    qk = state.params["enc_0"]["attn"]["q_proj"]["kernel"]
+    assert not qk.sharding.is_fully_replicated
+
+
+# -- config arithmetic ------------------------------------------------------
+
+
+def test_gpt2_param_count():
+    from torch_automatic_distributed_neural_network_tpu.models import (
+        gpt2_config,
+    )
+
+    cfg = gpt2_config("small")
+    n = cfg.num_params()
+    assert 1.1e8 < n < 1.4e8  # ~124M
+
+
+def test_llama8b_param_count():
+    from torch_automatic_distributed_neural_network_tpu.models import (
+        llama_config,
+    )
+
+    n = llama_config("8b").num_params()
+    assert 7.5e9 < n < 8.5e9

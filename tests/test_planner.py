@@ -125,3 +125,20 @@ def test_make_plan_explicit_mesh_auto_resolves(devices8):
     mesh = tad.build_mesh(fsdp=8)
     plan = planner.make_plan(transformer_like_params(), mesh=mesh)
     assert plan.strategy == "fsdp"
+
+
+def test_seq_parallel_conflicts_with_explicit_mesh(devices8):
+    mesh = tad.build_mesh(data=8)  # no seq axis
+    with pytest.raises(ValueError, match="seq_parallel"):
+        planner.make_plan(transformer_like_params(), mesh=mesh, seq=4)
+    # matching seq axis is fine
+    mesh = tad.build_mesh(data=2, seq=4)
+    plan = planner.make_plan(transformer_like_params(), mesh=mesh, seq=4)
+    assert tad.mesh_degrees(plan.mesh)["seq"] == 4
+
+
+def test_bad_strategy_rejected_with_explicit_mesh(devices8):
+    mesh = tad.build_mesh(fsdp=8)
+    with pytest.raises(ValueError, match="strategy"):
+        planner.make_plan(transformer_like_params(), mesh=mesh,
+                          strategy="fspd")

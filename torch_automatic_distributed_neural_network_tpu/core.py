@@ -44,12 +44,18 @@ from .training.optim import opt_state_spec_tree
 
 @struct.dataclass
 class TrainState:
-    """Minimal functional train state; a pytree, shardable leaf-by-leaf."""
+    """Minimal functional train state; a pytree, shardable leaf-by-leaf.
+
+    ``model_state`` carries non-trained variable collections (e.g. flax
+    ``batch_stats`` for BatchNorm models) — an empty dict for stateless
+    models.  The reference's analog is buffers on the wrapped nn.Module.
+    """
 
     step: jax.Array
     params: Any
     opt_state: Any
     rng: jax.Array
+    model_state: Any = struct.field(default_factory=dict)
 
 
 LossFn = Callable[..., Any]  # (params, batch, rng, apply_fn) -> loss | (loss, aux)
@@ -94,28 +100,50 @@ class AutoDistribute:
         remat: bool | None = None,
         donate: bool = True,
         devices: Sequence[jax.Device] | None = None,
+        seq_parallel: int = 1,
+        seq_impl: str = "auto",
     ):
         if model is None and init_fn is None:
             raise ValueError("Provide a model or an init_fn")
         self.model = model
         self.optimizer = optimizer or optax.adamw(1e-3)
         self._loss_fn = loss_fn
-        self._init_fn = init_fn or (lambda rng, batch: model.init(rng, _model_input(batch)))
+        self._init_fn = init_fn or (lambda rng, batch: _default_init(model, rng, batch))
         self._strategy = strategy
         self._mesh = mesh
         self._rules = rules
         self._remat = remat
         self._donate = donate
         self._devices = list(devices) if devices is not None else None
+        if seq_impl not in ("auto", "ring", "ulysses"):
+            raise ValueError(
+                f"seq_impl must be 'auto', 'ring' or 'ulysses', got {seq_impl!r}"
+            )
+        self._seq_parallel = seq_parallel
+        self._seq_impl = seq_impl
+        self._pctx = None
         self.plan: planner_mod.ShardPlan | None = None
         self._step_fn = None
         self._apply_fn = model.apply if model is not None else None
+        self._has_model_state = False
 
     # -- planning -----------------------------------------------------------
 
+    @staticmethod
+    def _split_variables(variables: Any) -> tuple[Any, dict]:
+        """Split flax variables into (params, model_state).  Bare param
+        trees (no 'params' collection) pass through with empty state."""
+        if isinstance(variables, dict) and "params" in variables:
+            params = variables["params"]
+            model_state = {k: v for k, v in variables.items() if k != "params"}
+            return params, model_state
+        return variables, {}
+
     def build_plan(self, rng: jax.Array, sample_batch: Any) -> planner_mod.ShardPlan:
         """Trace the init to abstract shapes and run the partition planner."""
-        abstract = jax.eval_shape(self._init_fn, rng, sample_batch)
+        abstract_vars = jax.eval_shape(self._init_fn, rng, sample_batch)
+        abstract, abstract_ms = self._split_variables(abstract_vars)
+        self._has_model_state = bool(jax.tree.leaves(abstract_ms))
         self.plan = planner_mod.make_plan(
             abstract,
             mesh=self._mesh,
@@ -123,6 +151,12 @@ class AutoDistribute:
             rules=self._rules,
             devices=self._devices,
             remat=self._remat,
+            seq=self._seq_parallel,
+        )
+        from .parallel import context as pctx
+
+        self._pctx = pctx.ParallelContext(
+            mesh=self.plan.mesh, seq_impl=self._seq_impl
         )
         return self.plan
 
@@ -152,6 +186,10 @@ class AutoDistribute:
             opt_state=jax.tree.map(ns, opt_specs,
                                    is_leaf=lambda x: isinstance(x, P)),
             rng=ns(P()),
+            # batch stats etc. are small — replicate
+            model_state=jax.tree.map(
+                lambda _: ns(P()), state_abstract.model_state
+            ),
         )
 
     # -- init ---------------------------------------------------------------
@@ -169,13 +207,16 @@ class AutoDistribute:
 
         def make_state(rng):
             init_rng, state_rng = jax.random.split(rng)
-            params = self._init_fn(init_rng, sample_batch)
+            params, model_state = self._split_variables(
+                self._init_fn(init_rng, sample_batch)
+            )
             opt_state = self.optimizer.init(params)
             return TrainState(
                 step=jnp.zeros((), jnp.int32),
                 params=params,
                 opt_state=opt_state,
                 rng=state_rng,
+                model_state=model_state,
             )
 
         abstract = jax.eval_shape(make_state, rng)
@@ -211,10 +252,21 @@ class AutoDistribute:
 
     # -- the train step -----------------------------------------------------
 
-    def _loss_for(self, params, batch, rng):
+    def _loss_for(self, params, model_state, batch, rng):
         if self._loss_fn is None:
             raise ValueError("AutoDistribute needs a loss_fn to train")
-        out = self._loss_fn(params, batch, rng, self._apply_fn)
+        if self._has_model_state:
+            # Stateful models (BatchNorm etc.): the loss_fn signature gains
+            # model_state and may return a 'model_state' key in aux.
+            out = self._loss_fn(params, model_state, batch, rng, self._apply_fn)
+        else:
+            apply = self._apply_fn
+            wrapped = (
+                (lambda p, *a, **k: apply({"params": p}, *a, **k))
+                if apply is not None
+                else None
+            )
+            out = self._loss_fn(params, batch, rng, wrapped)
         if isinstance(out, tuple):
             return out
         return out, {}
@@ -224,29 +276,39 @@ class AutoDistribute:
         assert plan is not None
         batch_sharding = plan.batch_sharding()
 
-        loss_for = self._loss_for
-        if plan.remat:
-            # Gradient checkpointing (C7): recompute everything but matmul
-            # outputs in the backward pass.
-            loss_for = jax.checkpoint(
-                loss_for,
-                policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
-                static_argnums=(),
-            )
+        from .parallel import context as pctx
 
         def train_step(state: TrainState, batch):
+            # trace-time: models read the active plan (cp/sp dispatch)
+            with pctx.use(self._pctx):
+                return traced_step(state, batch)
+
+        def traced_step(state: TrainState, batch):
             step_rng = jax.random.fold_in(state.rng, state.step)
-            grad_fn = jax.value_and_grad(loss_for, has_aux=True)
-            (loss, aux), grads = grad_fn(state.params, batch, step_rng)
+
+            def loss_inner(p):
+                return self._loss_for(p, state.model_state, batch, step_rng)
+
+            if plan.remat:
+                # Gradient checkpointing (C7): recompute everything but
+                # matmul outputs in the backward pass.
+                loss_inner = jax.checkpoint(
+                    loss_inner,
+                    policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+                )
+            grad_fn = jax.value_and_grad(loss_inner, has_aux=True)
+            (loss, aux), grads = grad_fn(state.params)
             updates, opt_state = self.optimizer.update(
                 grads, state.opt_state, state.params
             )
             params = optax.apply_updates(state.params, updates)
+            new_model_state = aux.pop("model_state", state.model_state)
             new_state = dataclasses.replace(
                 state,
                 step=state.step + 1,
                 params=params,
                 opt_state=opt_state,
+                model_state=new_model_state,
             )
             metrics = {"loss": loss, **aux}
             return new_state, metrics
@@ -268,11 +330,23 @@ class AutoDistribute:
     @functools.cached_property
     def _fwd(self):
         assert self._apply_fn is not None
-        return jax.jit(self._apply_fn)
+        return jax.jit(self._apply_fn, static_argnames=("train",))
 
-    def __call__(self, params, *args, **kwargs):
-        """Forward pass — parity with calling the wrapped reference model."""
-        return self._fwd(params, *args, **kwargs)
+    def __call__(self, state_or_params, *args, **kwargs):
+        """Forward pass — parity with calling the wrapped reference model.
+
+        Accepts a TrainState (stateful models use their batch stats) or a
+        bare param tree.
+        """
+        if isinstance(state_or_params, TrainState):
+            variables = {
+                "params": state_or_params.params,
+                **state_or_params.model_state,
+            }
+        else:
+            params, model_state = self._split_variables(state_or_params)
+            variables = {"params": params, **model_state}
+        return self._fwd(variables, *args, **kwargs)
 
     def shard_batch(self, batch):
         """Place a host-local batch onto the mesh with the plan's sharding."""
@@ -282,15 +356,33 @@ class AutoDistribute:
 
 
 def _model_input(batch):
-    """Extract the model input from a batch dict/tuple for model.init."""
+    """Extract the model input(s) from a batch dict/tuple for model.init."""
     if isinstance(batch, dict):
+        if "src" in batch and "tgt" in batch:
+            # seq2seq teacher forcing: model sees tgt[:-1] (seq2seq_loss
+            # convention); init must trace the same length
+            return (batch["src"], batch["tgt"][:, :-1])
         for k in ("x", "inputs", "input_ids", "image", "images", "tokens"):
             if k in batch:
-                return batch[k]
+                inp = batch[k]
+                # 'input_ids'/'tokens' follow the causal-LM convention of
+                # next_token_loss: batches carry S+1 tokens, the model is
+                # applied to the first S.  Custom objectives that use these
+                # key names differently must pass init_fn= explicitly.
+                if k in ("input_ids", "tokens") and getattr(inp, "ndim", 0) >= 2:
+                    return inp[:, :-1]
+                return inp
         return next(iter(batch.values()))
     if isinstance(batch, (tuple, list)):
         return batch[0]
     return batch
+
+
+def _default_init(model, rng, batch):
+    inp = _model_input(batch)
+    if isinstance(inp, tuple):
+        return model.init(rng, *inp)
+    return model.init(rng, inp)
 
 
 def autodistribute(

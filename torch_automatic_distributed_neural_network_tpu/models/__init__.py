@@ -1,5 +1,24 @@
 """Model zoo mirroring the reference's example models (SURVEY.md C11/C12)."""
 
+from .gpt2 import GPT2, gpt2_config
+from .llama import Llama, llama_config
 from .mlp import MLP
+from .resnet import ResNet, ResNet18Thin, ResNet50, ResNetConfig
+from .transformer_core import DecoderLM, TransformerConfig
+from .transformer_mt import Seq2SeqTransformer, TransformerMT
 
-__all__ = ["MLP"]
+__all__ = [
+    "MLP",
+    "GPT2",
+    "gpt2_config",
+    "Llama",
+    "llama_config",
+    "ResNet",
+    "ResNet50",
+    "ResNet18Thin",
+    "ResNetConfig",
+    "DecoderLM",
+    "TransformerConfig",
+    "Seq2SeqTransformer",
+    "TransformerMT",
+]

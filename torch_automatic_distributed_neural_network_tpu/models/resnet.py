@@ -1,0 +1,100 @@
+"""ResNet family (component C11; BASELINE.json:8 — "ResNet-50 / CIFAR-10
+data-parallel"; headline metric ResNet-50 images/sec/chip).
+
+TPU-first notes: NHWC layout (XLA:TPU's native conv layout), bfloat16
+compute with fp32 BatchNorm statistics.  Under a jit'd global-batch
+program the BatchNorm batch reduction is computed over the full global
+batch (GSPMD inserts the cross-replica mean) — i.e. SyncBN semantics for
+free, which is what keeps N-device training exactly equal to 1-device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)  # ResNet-50
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+    small_inputs: bool = False  # CIFAR stem (3x3/1) vs ImageNet stem (7x7/2)
+
+
+class Bottleneck(nn.Module):
+    features: int
+    strides: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        bn = partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=self.dtype,
+        )
+        residual = x
+        y = conv(self.features, (1, 1), name="conv1")(x)
+        y = bn(name="bn1")(y)
+        y = nn.relu(y)
+        y = conv(self.features, (3, 3), (self.strides, self.strides),
+                 name="conv2")(y)
+        y = bn(name="bn2")(y)
+        y = nn.relu(y)
+        y = conv(self.features * 4, (1, 1), name="conv3")(y)
+        y = bn(name="bn3", scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(
+                self.features * 4, (1, 1), (self.strides, self.strides),
+                name="proj_conv",
+            )(residual)
+            residual = bn(name="proj_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    cfg: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cfg = self.cfg
+        x = x.astype(cfg.dtype)
+        if cfg.small_inputs:
+            x = nn.Conv(cfg.width, (3, 3), use_bias=False, dtype=cfg.dtype,
+                        name="stem_conv")(x)
+        else:
+            x = nn.Conv(cfg.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                        use_bias=False, dtype=cfg.dtype, name="stem_conv")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=cfg.dtype, name="stem_bn")(x)
+        x = nn.relu(x)
+        if not cfg.small_inputs:
+            x = nn.max_pool(x, (3, 3), (2, 2), padding=[(1, 1), (1, 1)])
+        for i, n_blocks in enumerate(cfg.stage_sizes):
+            for j in range(n_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = Bottleneck(
+                    cfg.width * 2**i, strides, cfg.dtype,
+                    name=f"stage{i}_block{j}",
+                )(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(cfg.num_classes, dtype=jnp.float32, name="classifier")(x)
+        return x.astype(jnp.float32)
+
+
+def ResNet50(num_classes: int = 1000, **kw) -> ResNet:
+    return ResNet(ResNetConfig(num_classes=num_classes, **kw))
+
+
+def ResNet18Thin(num_classes: int = 10, **kw) -> ResNet:
+    """Small variant for tests/CPU sim."""
+    return ResNet(ResNetConfig(
+        stage_sizes=(2, 2), num_classes=num_classes, width=16,
+        small_inputs=True, **kw,
+    ))

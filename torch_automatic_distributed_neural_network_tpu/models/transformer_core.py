@@ -1,0 +1,212 @@
+"""Decoder-only transformer core shared by the GPT-2 and Llama families
+(component C12).
+
+One config-driven module covers both: GPT-2 = LayerNorm + learned positions
++ GELU MLP; Llama = RMSNorm + RoPE + SwiGLU + GQA.  Design choices are
+TPU-first:
+
+- bfloat16 compute / fp32 params by default (MXU-native);
+- ``nn.scan`` over layers: one traced layer compiled once (compile time
+  O(1) in depth) and a natural substrate for pipeline stage loops;
+- per-layer ``nn.remat`` so FSDP configs recompute activations
+  (BASELINE.json:11 pairs FSDP with gradient checkpointing);
+- parameter names (q_proj/o_proj/up_proj/down_proj/embed/lm_head) line up
+  with the planner's Megatron TP rules, which anchor on *trailing* dims so
+  scanned [layer, ...] stacking keeps the same specs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Literal
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.attention import attention
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    n_kv_heads: int | None = None  # None -> MHA; < n_heads -> GQA
+    d_ff: int | None = None  # None -> 4*d_model (gelu) / 8/3*d_model (swiglu)
+    max_seq_len: int = 1024
+    norm: Literal["layernorm", "rmsnorm"] = "layernorm"
+    act: Literal["gelu", "swiglu"] = "gelu"
+    pos: Literal["learned", "rope"] = "learned"
+    tie_embeddings: bool = True
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.bfloat16  # compute dtype; params stay fp32
+    attention_impl: str = "auto"
+    scan_layers: bool = True
+    remat: bool = True
+    rope_theta: float = 10000.0
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def ff_dim(self) -> int:
+        if self.d_ff is not None:
+            return self.d_ff
+        if self.act == "swiglu":
+            # Llama convention: 2/3 * 4d rounded to a multiple of 256
+            d = int(8 * self.d_model / 3)
+            return (d + 255) // 256 * 256
+        return 4 * self.d_model
+
+    def num_params(self) -> int:
+        """Analytic parameter count (embedding included once if tied)."""
+        d, f, L, v = self.d_model, self.ff_dim, self.n_layers, self.vocab_size
+        hd = self.head_dim
+        attn = d * (self.n_heads * hd) + 2 * d * (self.kv_heads * hd) + (
+            self.n_heads * hd) * d
+        mlp = (3 if self.act == "swiglu" else 2) * d * f
+        norms = (2 * d) * L + d
+        emb = v * d * (1 if self.tie_embeddings else 2)
+        pos = self.max_seq_len * d if self.pos == "learned" else 0
+        return L * (attn + mlp) + norms + emb + pos
+
+
+def make_norm(cfg: TransformerConfig, name: str):
+    if cfg.norm == "rmsnorm":
+        return nn.RMSNorm(epsilon=1e-5, dtype=cfg.dtype, name=name)
+    return nn.LayerNorm(epsilon=1e-5, dtype=cfg.dtype, name=name)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary position embedding on [B, S, H, D] (rotate-half formulation)."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (np.arange(0, d, 2, dtype=np.float32) / d))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, D/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+class SelfAttention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions, mask=None):
+        cfg = self.cfg
+        hd = cfg.head_dim
+        dense = lambda feats, name: nn.DenseGeneral(
+            feats, axis=-1, dtype=cfg.dtype, name=name, use_bias=cfg.norm == "layernorm"
+        )
+        q = dense((cfg.n_heads, hd), "q_proj")(x)
+        k = dense((cfg.kv_heads, hd), "k_proj")(x)
+        v = dense((cfg.kv_heads, hd), "v_proj")(x)
+        if cfg.pos == "rope":
+            q = rope(q, positions, cfg.rope_theta)
+            k = rope(k, positions, cfg.rope_theta)
+        out = attention(q, k, v, causal=True, mask=mask, impl=cfg.attention_impl)
+        return nn.DenseGeneral(
+            cfg.d_model, axis=(-2, -1), dtype=cfg.dtype, name="o_proj",
+            use_bias=cfg.norm == "layernorm",
+        )(out)
+
+
+class MLPBlock(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        bias = cfg.norm == "layernorm"
+        if cfg.act == "swiglu":
+            gate = nn.Dense(cfg.ff_dim, dtype=cfg.dtype, use_bias=bias,
+                            name="gate_proj")(x)
+            up = nn.Dense(cfg.ff_dim, dtype=cfg.dtype, use_bias=bias,
+                          name="up_proj")(x)
+            h = nn.silu(gate) * up
+        else:
+            h = nn.Dense(cfg.ff_dim, dtype=cfg.dtype, use_bias=bias,
+                         name="up_proj")(x)
+            h = nn.gelu(h)
+        return nn.Dense(cfg.d_model, dtype=cfg.dtype, use_bias=bias,
+                        name="down_proj")(h)
+
+
+class DecoderLayer(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions, mask=None):
+        cfg = self.cfg
+        h = make_norm(cfg, "attn_norm")(x)
+        h = SelfAttention(cfg, name="attn")(h, positions, mask)
+        if cfg.dropout_rate:
+            h = nn.Dropout(cfg.dropout_rate, deterministic=not self.has_rng("dropout"))(h)
+        x = x + h
+        h = make_norm(cfg, "mlp_norm")(x)
+        h = MLPBlock(cfg, name="mlp")(h)
+        if cfg.dropout_rate:
+            h = nn.Dropout(cfg.dropout_rate, deterministic=not self.has_rng("dropout"))(h)
+        return x + h
+
+
+class DecoderLM(nn.Module):
+    """Causal language model: GPT-2 / Llama families by config."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, positions=None, mask=None):
+        cfg = self.cfg
+        if positions is None:
+            positions = jnp.arange(tokens.shape[1])[None, :]
+            positions = jnp.broadcast_to(positions, tokens.shape)
+        embed = nn.Embed(
+            cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+            embedding_init=nn.initializers.normal(0.02), name="embed",
+        )
+        x = embed(tokens)
+        if cfg.pos == "learned":
+            pos_emb = self.param(
+                "pos_embed", nn.initializers.normal(0.02),
+                (cfg.max_seq_len, cfg.d_model), jnp.float32,
+            )
+            x = x + pos_emb[None, : tokens.shape[1]].astype(cfg.dtype)
+
+        layer_cls = DecoderLayer
+        if cfg.remat:
+            layer_cls = nn.remat(
+                DecoderLayer,
+                policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+                prevent_cse=not cfg.scan_layers,
+            )
+        if cfg.scan_layers:
+            x, _ = nn.scan(
+                lambda mdl, carry, _: (mdl(carry, positions, mask), None),
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=cfg.n_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(layer_cls(cfg, name="layers"), x, None)
+        else:
+            for i in range(cfg.n_layers):
+                x = layer_cls(cfg, name=f"layers_{i}")(x, positions, mask)
+
+        x = make_norm(cfg, "final_norm")(x)
+        if cfg.tie_embeddings:
+            logits = embed.attend(x.astype(jnp.float32))
+        else:
+            logits = nn.Dense(
+                cfg.vocab_size, dtype=jnp.float32, use_bias=False,
+                name="lm_head",
+            )(x)
+        return logits.astype(jnp.float32)

@@ -1,0 +1,158 @@
+"""Encoder-decoder transformer for machine translation (component C12;
+BASELINE.json:9 — "Transformer-base MT / WMT14 en-de (bucketed DDP path)").
+
+Transformer-base dimensions (6+6 layers, d=512, 8 heads, ff=2048) on the
+same TPU-first building blocks as the decoder core: bfloat16 compute,
+TP-rule-compatible parameter names, optional layer scan.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import attention
+from .transformer_core import MLPBlock, TransformerConfig, make_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class Seq2SeqConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 6  # per stack
+    n_heads: int = 8
+    d_ff: int = 2048
+    max_seq_len: int = 256
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.bfloat16
+
+    def as_core(self) -> TransformerConfig:
+        return TransformerConfig(
+            vocab_size=self.vocab_size,
+            d_model=self.d_model,
+            n_layers=self.n_layers,
+            n_heads=self.n_heads,
+            d_ff=self.d_ff,
+            max_seq_len=self.max_seq_len,
+            norm="layernorm",
+            act="gelu",
+            pos="learned",
+            dropout_rate=self.dropout_rate,
+            dtype=self.dtype,
+        )
+
+
+class CrossAttention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, memory, mask=None):
+        cfg = self.cfg
+        hd = cfg.head_dim
+        dense = lambda feats, name: nn.DenseGeneral(
+            feats, axis=-1, dtype=cfg.dtype, name=name, use_bias=True
+        )
+        q = dense((cfg.n_heads, hd), "q_proj")(x)
+        k = dense((cfg.n_heads, hd), "k_proj")(memory)
+        v = dense((cfg.n_heads, hd), "v_proj")(memory)
+        out = attention(q, k, v, causal=False, mask=mask)
+        return nn.DenseGeneral(
+            cfg.d_model, axis=(-2, -1), dtype=cfg.dtype, name="o_proj",
+            use_bias=True,
+        )(out)
+
+
+class SelfAttentionMT(nn.Module):
+    cfg: TransformerConfig
+    causal: bool
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        cfg = self.cfg
+        hd = cfg.head_dim
+        dense = lambda feats, name: nn.DenseGeneral(
+            feats, axis=-1, dtype=cfg.dtype, name=name, use_bias=True
+        )
+        q = dense((cfg.n_heads, hd), "q_proj")(x)
+        k = dense((cfg.n_heads, hd), "k_proj")(x)
+        v = dense((cfg.n_heads, hd), "v_proj")(x)
+        out = attention(q, k, v, causal=self.causal, mask=mask)
+        return nn.DenseGeneral(
+            cfg.d_model, axis=(-2, -1), dtype=cfg.dtype, name="o_proj",
+            use_bias=True,
+        )(out)
+
+
+class EncoderLayer(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        h = make_norm(self.cfg, "attn_norm")(x)
+        x = x + SelfAttentionMT(self.cfg, causal=False, name="attn")(h, mask)
+        h = make_norm(self.cfg, "mlp_norm")(x)
+        return x + MLPBlock(self.cfg, name="mlp")(h)
+
+
+class DecoderLayerMT(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, memory, self_mask=None, cross_mask=None):
+        h = make_norm(self.cfg, "attn_norm")(x)
+        x = x + SelfAttentionMT(self.cfg, causal=True, name="attn")(h, self_mask)
+        h = make_norm(self.cfg, "cross_norm")(x)
+        x = x + CrossAttention(self.cfg, name="cross_attn")(h, memory, cross_mask)
+        h = make_norm(self.cfg, "mlp_norm")(x)
+        return x + MLPBlock(self.cfg, name="mlp")(h)
+
+
+class Seq2SeqTransformer(nn.Module):
+    """__call__(src_tokens, tgt_tokens) -> logits over the target vocab.
+
+    Teacher-forced training interface matching the reference's MT example:
+    the loss shifts ``tgt`` internally (see training.losses.seq2seq_loss).
+    """
+
+    cfg: Seq2SeqConfig
+
+    @nn.compact
+    def __call__(self, src, tgt):
+        core = self.cfg.as_core()
+        embed = nn.Embed(
+            core.vocab_size, core.d_model, dtype=core.dtype,
+            embedding_init=nn.initializers.normal(0.02), name="embed",
+        )
+        pos_emb = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (core.max_seq_len, core.d_model), jnp.float32,
+        )
+
+        def add_pos(x, length):
+            return x + pos_emb[None, :length].astype(core.dtype)
+
+        mem = add_pos(embed(src), src.shape[1])
+        for i in range(self.cfg.n_layers):
+            mem = EncoderLayer(core, name=f"enc_{i}")(mem)
+        mem = make_norm(core, "enc_norm")(mem)
+
+        y = add_pos(embed(tgt), tgt.shape[1])
+        for i in range(self.cfg.n_layers):
+            y = DecoderLayerMT(core, name=f"dec_{i}")(y, mem)
+        y = make_norm(core, "dec_norm")(y)
+        return embed.attend(y.astype(jnp.float32)).astype(jnp.float32)
+
+
+def TransformerMT(size: str = "base", **overrides) -> Seq2SeqTransformer:
+    presets = {
+        "base": dict(),
+        "big": dict(d_model=1024, n_heads=16, d_ff=4096),
+        "test": dict(d_model=64, n_layers=2, n_heads=4, d_ff=128,
+                     vocab_size=512, max_seq_len=64),
+    }
+    kw = {**presets[size], **overrides}
+    return Seq2SeqTransformer(Seq2SeqConfig(**kw))

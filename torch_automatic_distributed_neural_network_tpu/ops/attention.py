@@ -1,0 +1,136 @@
+"""Attention kernels with a single dispatch surface.
+
+Implementations:
+
+- ``xla``   — plain jnp einsum attention; XLA fuses it well for moderate
+              sequence lengths and it runs everywhere (CPU sim included).
+- ``flash`` — Pallas block-streaming attention (ops/flash_attention.py),
+              O(seq) memory, MXU-tiled; TPU only.
+- ``ring``  — context-parallel ring attention (parallel/ring.py): KV blocks
+              rotate around the ``seq`` mesh axis via ppermute with
+              online-softmax accumulation (SURVEY.md §3.4).
+
+Models call :func:`attention` and the parallel plan decides the impl; the
+CPU-sim tests exercise every impl against the ``xla`` oracle.
+
+Shapes follow the TPU-friendly convention [batch, seq, heads, head_dim]
+(BSHD) — keeps the trailing two dims MXU-tileable after the head fold.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Literal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Impl = Literal["xla", "flash", "ring", "auto"]
+
+
+def _mask_bias(scores_dtype, mask):
+    big_neg = jnp.finfo(scores_dtype).min * 0.5
+    return jnp.where(mask, 0.0, big_neg).astype(scores_dtype)
+
+
+def xla_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    mask: jax.Array | None = None,
+    softmax_dtype=jnp.float32,
+) -> jax.Array:
+    """Reference einsum attention.  q,k,v: [B, S, H, D] (k,v may have fewer
+    heads for GQA — broadcast over query groups)."""
+    b, sq, hq, d = q.shape
+    _, sk, hk, _ = k.shape
+    if hk != hq:
+        assert hq % hk == 0, (hq, hk)
+        k = jnp.repeat(k, hq // hk, axis=2)
+        v = jnp.repeat(v, hq // hk, axis=2)
+    scale = 1.0 / np.sqrt(d)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(softmax_dtype) * scale
+    if causal:
+        causal_mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        scores = scores + _mask_bias(scores.dtype, causal_mask[None, None])
+    if mask is not None:
+        # mask: [B, 1|H, Q|1, K] boolean, True = attend
+        scores = scores + _mask_bias(scores.dtype, mask)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+
+
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    mask: jax.Array | None = None,
+    impl: Impl = "auto",
+) -> jax.Array:
+    """Dispatching attention entry point used by all models.
+
+    With impl='auto': if the ambient ParallelContext has a nontrivial
+    ``seq`` axis, context parallelism kicks in — Ulysses when the local
+    head count divides the cp degree (cheapest: two all_to_alls), ring
+    attention otherwise (SURVEY.md §5 long-context tiers).  Without a
+    context (or cp=1): plain XLA attention.
+    """
+    from ..parallel import context as pctx
+
+    ctx = pctx.current()
+    cp = ctx.seq_degree if ctx is not None else 1
+
+    if impl == "auto":
+        if cp > 1:
+            if ctx.seq_impl in ("ring", "ulysses"):
+                impl = ctx.seq_impl  # user override via AutoDistribute
+            else:
+                tp = ctx.degrees.get(ctx.head_axis, 1)
+                local_heads = q.shape[2] // max(tp, 1)
+                seq = q.shape[1]
+                if local_heads % cp == 0 and seq <= 8192:
+                    impl = "ulysses"
+                else:
+                    impl = "ring"
+        else:
+            impl = "xla"
+
+    if impl == "xla":
+        return xla_attention(q, k, v, causal=causal, mask=mask)
+    if impl == "flash":
+        from .flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal)
+    if impl in ("ring", "ulysses"):
+        if mask is not None:
+            raise NotImplementedError(
+                f"{impl} attention does not take explicit masks (causal only)"
+            )
+        if ctx is None or cp <= 1:
+            # degenerate: no seq axis -> plain attention is identical
+            return xla_attention(q, k, v, causal=causal)
+        head_axis = (
+            ctx.head_axis if ctx.degrees.get(ctx.head_axis, 1) > 1 else None
+        )
+        from jax.sharding import PartitionSpec as P
+
+        batch_spec = P(ctx.batch_spec_entry())
+        if impl == "ring":
+            from ..parallel.ring import ring_attention_sharded
+
+            return ring_attention_sharded(
+                q, k, v, ctx.mesh, causal=causal, axis_name=ctx.seq_axis,
+                batch_spec=batch_spec, head_axis=head_axis,
+            )
+        from ..parallel.ulysses import ulysses_attention_sharded
+
+        return ulysses_attention_sharded(
+            q, k, v, ctx.mesh, causal=causal, axis_name=ctx.seq_axis,
+            batch_spec=batch_spec, head_axis=head_axis,
+        )
+    raise ValueError(f"Unknown attention impl {impl!r}")

@@ -1,5 +1,5 @@
 """Parallel execution strategies (SURVEY.md §2.2) and the comm backend."""
 
-from . import collectives
+from . import collectives, context, ring, ulysses
 
-__all__ = ["collectives"]
+__all__ = ["collectives", "context", "ring", "ulysses"]

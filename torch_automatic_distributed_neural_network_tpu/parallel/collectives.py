@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 
 # ---------------------------------------------------------------------------
@@ -150,7 +150,7 @@ def bench_collective(
 
     @partial(
         shard_map, mesh=mesh, in_specs=P(axis), out_specs=out_specs,
-        check_rep=False,
+        check_vma=False,
     )
     def run(x):
         return fn(x)

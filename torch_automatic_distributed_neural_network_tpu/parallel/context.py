@@ -1,0 +1,87 @@
+"""Ambient parallel context: how model code learns about the active plan.
+
+The reference's wrappers mutate the module tree; in the functional JAX
+world the model is pure, so AutoDistribute publishes the active
+(mesh, axis roles) here while tracing the train step, and ops.attention
+reads it to pick ring / Ulysses / plain attention and to apply
+sequence-sharding constraints.  Trace-time only — nothing here is used at
+runtime (everything lowers into the compiled program).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import dataclasses
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelContext:
+    mesh: Mesh
+    batch_axes: tuple[str, ...] = ("data", "fsdp")
+    seq_axis: str = "seq"
+    head_axis: str = "tensor"
+    seq_impl: str = "auto"  # 'auto' | 'ring' | 'ulysses'
+
+    @property
+    def degrees(self) -> dict[str, int]:
+        return {a: int(n) for a, n in
+                zip(self.mesh.axis_names, self.mesh.devices.shape)}
+
+    @property
+    def seq_degree(self) -> int:
+        return self.degrees.get(self.seq_axis, 1)
+
+    @property
+    def present_batch_axes(self) -> tuple[str, ...]:
+        d = self.degrees
+        return tuple(a for a in self.batch_axes if d.get(a, 1) > 1)
+
+    def batch_spec_entry(self):
+        axes = self.present_batch_axes
+        return axes if axes else None
+
+    def activation_spec(self, *, seq_sharded: bool = True) -> P:
+        """[batch, seq, hidden...] activation sharding under this context."""
+        return P(
+            self.batch_spec_entry(),
+            self.seq_axis if seq_sharded and self.seq_degree > 1 else None,
+        )
+
+
+_ctx: contextvars.ContextVar[ParallelContext | None] = contextvars.ContextVar(
+    "tadnn_parallel_context", default=None
+)
+
+
+def current() -> ParallelContext | None:
+    return _ctx.get()
+
+
+@contextlib.contextmanager
+def use(ctx: ParallelContext | None):
+    token = _ctx.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _ctx.reset(token)
+
+
+def shard_activations(x: jax.Array, *, seq_sharded: bool = True) -> jax.Array:
+    """Megatron-SP style activation sharding constraint: no-op without an
+    active context or a trivial mesh."""
+    ctx = current()
+    if ctx is None:
+        return x
+    d = ctx.degrees
+    if all(d.get(a, 1) == 1 for a in (*ctx.batch_axes, ctx.seq_axis)):
+        return x
+    spec = ctx.activation_spec(seq_sharded=seq_sharded)
+    ndim_pad = x.ndim - len(spec)
+    full = P(*spec, *([None] * ndim_pad))
+    return jax.lax.with_sharding_constraint(
+        x, jax.sharding.NamedSharding(ctx.mesh, full)
+    )

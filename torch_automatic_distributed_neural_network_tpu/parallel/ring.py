@@ -1,0 +1,139 @@
+"""Ring attention — context parallelism over the ``seq`` mesh axis
+(SURVEY.md §3.4, §2.2 'Ring attention').
+
+Each device holds one sequence block of Q and one of K/V.  K/V blocks
+rotate around the ICI ring via ``ppermute`` while every device folds each
+visiting block into its local attention accumulator with the online-softmax
+(flash) recurrence — so attention over a sequence of length S costs
+O(S/cp) memory per chip and the ring hop overlaps with the block matmuls.
+
+This module is the *explicit-collective* tier: it must be called inside a
+``shard_map`` region where q/k/v are sharded along ``axis_name``.  The
+model-facing dispatch (ops.attention with impl='ring') applies the
+shard_map using the ambient ParallelContext.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+_NEG_BIG = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _block_attn(q, k, v, bias):
+    """One flash block: returns (unnormalized_out, row_max, row_sum).
+
+    q: [B, Sq, H, D]; k,v: [B, Sk, H, D]; bias: [B, 1|H, Sq, Sk] or None.
+    All accumulation in fp32.
+    """
+    d = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if bias is not None:
+        s = s + bias
+    m = jnp.max(s, axis=-1)  # [B, H, Sq]
+    # guard fully-masked rows: exp(-big - (-big)) would be exp(0)=1
+    m_safe = jnp.maximum(m, _NEG_BIG / 2)
+    p = jnp.exp(s - m_safe[..., None])  # [B, H, Sq, Sk]
+    l = jnp.sum(p, axis=-1)  # [B, H, Sq]
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return o, m_safe, l
+
+
+def _merge(o, m, l, o2, m2, l2):
+    """Merge two online-softmax partial results."""
+    m_new = jnp.maximum(m, m2)
+    a = jnp.exp(m - m_new)
+    b = jnp.exp(m2 - m_new)
+    l_new = l * a + l2 * b
+    o_new = o * a.transpose(0, 2, 1)[..., None] + o2 * b.transpose(0, 2, 1)[..., None]
+    return o_new, m_new, l_new
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    axis_name: str = "seq",
+) -> jax.Array:
+    """Block-ring attention; call inside shard_map with q/k/v sharded on
+    the sequence dim over ``axis_name``.  Shapes [B, S_local, H|Hkv, D].
+
+    GQA: fewer k/v heads than q heads are broadcast before the ring so the
+    recurrence stays head-aligned.
+    """
+    cp = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, sl, hq, dh = q.shape
+    hk = k.shape[2]
+    if hk != hq:
+        rep = hq // hk
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+    q_pos = my * sl + jnp.arange(sl)  # global positions of local queries
+
+    def body(step, carry):
+        o, m, l, kb, vb = carry
+        # block kb originated on device (my - step) % cp
+        origin = (my - step) % cp
+        kv_pos = origin * sl + jnp.arange(sl)
+        if causal:
+            mask = q_pos[:, None] >= kv_pos[None, :]  # [Sq, Sk]
+            bias = jnp.where(mask, 0.0, _NEG_BIG)[None, None]
+        else:
+            bias = None
+        o2, m2, l2 = _block_attn(q, kb, vb, bias)
+        o, m, l = _merge(o, m, l, o2, m2, l2)
+        # rotate kv to the next device (uniform across the ring every step;
+        # the final hop restores the original placement)
+        kb, vb = _rotate((kb, vb), axis_name)
+        return o, m, l, kb, vb
+
+    o0 = jnp.zeros((b, sl, hq, dh), jnp.float32)
+    m0 = jnp.full((b, hq, sl), _NEG_BIG, jnp.float32)
+    l0 = jnp.zeros((b, hq, sl), jnp.float32)
+    o, m, l, _, _ = jax.lax.fori_loop(0, cp, body, (o0, m0, l0, k, v))
+    l = jnp.maximum(l, 1e-30)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def _rotate(kv, axis_name):
+    n = jax.lax.axis_size(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    return jax.tree.map(lambda x: jax.lax.ppermute(x, axis_name, perm), kv)
+
+
+def ring_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = True,
+    axis_name: str = "seq",
+    batch_spec=P(("data", "fsdp")),
+    head_axis: str | None = "tensor",
+) -> jax.Array:
+    """Apply ring attention to *unsharded-view* arrays under ``mesh`` by
+    wrapping it in shard_map (the model-facing adapter)."""
+    spec = P(batch_spec[0] if len(batch_spec) else None, axis_name,
+             head_axis, None)
+
+    fn = shard_map(
+        functools.partial(ring_attention, causal=causal, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

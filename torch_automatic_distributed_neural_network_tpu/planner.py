@@ -322,6 +322,7 @@ def make_plan(
     rules: Sequence[Rule] = TRANSFORMER_RULES,
     devices: Sequence[jax.Device] | None = None,
     remat: bool | None = None,
+    seq: int = 1,
 ) -> ShardPlan:
     """The planner: abstract params + topology -> ShardPlan.
 
@@ -336,16 +337,26 @@ def make_plan(
     topo = topo_mod.detect(devices)
     resolved = strategy
     if mesh is None:
+        n = topo.num_devices
+        if seq > 1:
+            if n % seq:
+                raise ValueError(
+                    f"seq-parallel degree {seq} does not divide "
+                    f"{n} devices"
+                )
+            n //= seq
         if strategy == "auto":
-            resolved, degrees = choose_strategy(abstract_params, topo, rules)
+            resolved, degrees = choose_strategy(
+                abstract_params, dataclasses.replace(topo, num_devices=n),
+                rules,
+            )
         elif strategy == "dp":
-            degrees = {"data": topo.num_devices}
+            degrees = {"data": n}
         elif strategy == "fsdp":
-            degrees = {"fsdp": topo.num_devices}
+            degrees = {"fsdp": n}
         elif strategy == "tp":
-            degrees = {"tensor": topo.num_devices}
+            degrees = {"tensor": n}
         elif strategy == "tp_fsdp":
-            n = topo.num_devices
             t = min(8, n)
             while n % t:
                 t //= 2
@@ -355,17 +366,26 @@ def make_plan(
             degrees = {"fsdp": n // t, "tensor": t}
         else:
             raise ValueError(f"Unknown strategy {strategy!r}")
+        if seq > 1:
+            degrees["seq"] = seq
         mesh = topo_mod.build_mesh(devices=devices, **degrees)
-    elif strategy == "auto":
-        d = topo_mod.mesh_degrees(mesh)
-        if d.get("tensor", 1) > 1 and d.get("fsdp", 1) > 1:
-            resolved = "tp_fsdp"
-        elif d.get("tensor", 1) > 1:
-            resolved = "tp"
-        elif d.get("fsdp", 1) > 1:
-            resolved = "fsdp"
-        else:
-            resolved = "dp"
+    else:
+        if seq > 1 and topo_mod.mesh_degrees(mesh).get("seq", 1) != seq:
+            raise ValueError(
+                f"seq_parallel={seq} conflicts with the explicit mesh "
+                f"(its 'seq' axis is {topo_mod.mesh_degrees(mesh).get('seq', 1)}); "
+                "build the mesh with seq=<degree> or drop seq_parallel"
+            )
+        if strategy == "auto":
+            d = topo_mod.mesh_degrees(mesh)
+            if d.get("tensor", 1) > 1 and d.get("fsdp", 1) > 1:
+                resolved = "tp_fsdp"
+            elif d.get("tensor", 1) > 1:
+                resolved = "tp"
+            elif d.get("fsdp", 1) > 1:
+                resolved = "fsdp"
+            else:
+                resolved = "dp"
 
     param_specs = param_spec_tree(abstract_params, mesh, resolved, rules)
     degrees_final = topo_mod.mesh_degrees(mesh)

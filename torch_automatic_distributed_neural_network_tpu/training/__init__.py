@@ -1,5 +1,45 @@
-"""Training-loop subsystems: optimizer sharding, losses, metrics, checkpoints."""
+"""Training-loop subsystems: losses, optimizer sharding, metrics,
+checkpointing, trainer.
 
-from .losses import softmax_xent_loss, next_token_loss, mse_loss
+Checkpoint/trainer symbols are lazy (module __getattr__) so importing the
+framework does not hard-depend on orbax; ``from ..training import
+CheckpointManager`` still works and only then imports orbax.
+"""
 
-__all__ = ["softmax_xent_loss", "next_token_loss", "mse_loss"]
+from .losses import (
+    mse_loss,
+    next_token_loss,
+    seq2seq_loss,
+    softmax_xent_loss,
+    softmax_xent_loss_mutable,
+)
+from .metrics import MetricsLogger, peak_flops_per_chip, transformer_step_flops
+
+_LAZY = {
+    "CheckpointManager": "checkpoint",
+    "abstract_state_for": "checkpoint",
+    "restore_or_init": "checkpoint",
+    "Trainer": "trainer",
+    "TrainerConfig": "trainer",
+}
+
+__all__ = [
+    "softmax_xent_loss",
+    "softmax_xent_loss_mutable",
+    "next_token_loss",
+    "seq2seq_loss",
+    "mse_loss",
+    "MetricsLogger",
+    "peak_flops_per_chip",
+    "transformer_step_flops",
+    *_LAZY,
+]
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        mod = importlib.import_module(f".{_LAZY[name]}", __name__)
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

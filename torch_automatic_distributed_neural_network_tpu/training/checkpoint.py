@@ -1,0 +1,148 @@
+"""Sharded checkpoint / resume (SURVEY.md §5).
+
+Reference analog: ``torch.save`` of module state dicts.  TPU-native:
+Orbax sharded checkpointing — every host writes its own shards, metadata
+records the mesh/PartitionSpecs, and **resharding on restore** (loading a
+checkpoint written on mesh A into mesh B) is first-class: restore takes
+the *target* shardings, so elastic resume onto a different slice shape
+works out of the box (TPU slices fail whole; recovery = resume elsewhere).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+from typing import TYPE_CHECKING
+
+import jax
+import orbax.checkpoint as ocp
+
+if TYPE_CHECKING:  # runtime import would be circular (core -> training)
+    from ..core import AutoDistribute, TrainState
+
+
+class CheckpointManager:
+    """Thin wrapper over an Orbax CheckpointManager for TrainStates."""
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        max_to_keep: int = 3,
+        save_interval_steps: int = 0,
+    ):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mngr = ocp.CheckpointManager(
+            self.directory,
+            item_names=("state", "config"),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps or 1,
+                enable_async_checkpointing=True,
+            ),
+        )
+
+    def save(self, step: int, state: "TrainState", config: dict | None = None,
+             force: bool = False) -> bool:
+        args = {
+            "state": ocp.args.StandardSave(state),
+            "config": ocp.args.JsonSave(config if config is not None else {}),
+        }
+        return self._mngr.save(step, args=ocp.args.Composite(**args),
+                               force=force)
+
+    def latest_step(self) -> int | None:
+        return self._mngr.latest_step()
+
+    def restore(
+        self,
+        abstract_state: Any,
+        step: int | None = None,
+    ) -> "TrainState":
+        """Restore into the given abstract state (ShapeDtypeStructs carrying
+        target shardings) — resharding happens inside Orbax when the target
+        mesh differs from the one the checkpoint was written on."""
+        step = self._mngr.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"No checkpoint found in {self.directory}")
+        out = self._mngr.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(abstract_state)
+            ),
+        )
+        return out["state"]
+
+    def restore_config(self, step: int | None = None) -> dict | None:
+        step = self._mngr.latest_step() if step is None else step
+        if step is None:
+            return None
+        try:
+            out = self._mngr.restore(
+                step, args=ocp.args.Composite(config=ocp.args.JsonRestore())
+            )
+            return out.get("config")
+        except Exception:
+            return None
+
+    def wait(self) -> None:
+        self._mngr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mngr.wait_until_finished()
+        self._mngr.close()
+
+
+def abstract_state_for(ad: "AutoDistribute", rng, sample_batch) -> Any:
+    """Abstract TrainState (shapes+dtypes+target shardings) for restore.
+
+    Builds the plan if needed, so a fresh process can restore without ever
+    materializing an unsharded state.
+    """
+    if ad.plan is None:
+        ad.build_plan(rng, sample_batch)
+
+    def make_state(rng):
+        import jax.numpy as jnp
+
+        from ..core import TrainState
+
+        init_rng, state_rng = jax.random.split(rng)
+        params, model_state = ad._split_variables(ad._init_fn(init_rng, sample_batch))
+        opt_state = ad.optimizer.init(params)
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=opt_state,
+            rng=state_rng,
+            model_state=model_state,
+        )
+
+    abstract = jax.eval_shape(make_state, rng)
+    shardings = ad.state_shardings(abstract)
+    return jax.tree.map(
+        lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+        abstract,
+        shardings,
+    )
+
+
+def restore_or_init(
+    ad: "AutoDistribute",
+    ckpt: CheckpointManager | None,
+    rng,
+    sample_batch,
+) -> "tuple[TrainState, bool]":
+    """Resume from the latest checkpoint if one exists, else fresh init.
+    Returns (state, resumed).  The jitted step is compiled either way."""
+    if ckpt is not None and ckpt.latest_step() is not None:
+        abstract = abstract_state_for(ad, rng, sample_batch)
+        state = ckpt.restore(abstract)
+        # compile the step against the restored abstract state
+        shardings = ad.state_shardings(abstract)
+        ad._compile_step(abstract, shardings)
+        return state, True
+    return ad.init(rng, sample_batch), False

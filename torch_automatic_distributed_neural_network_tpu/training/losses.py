@@ -40,6 +40,33 @@ def next_token_loss(params, batch, rng, apply_fn):
     return loss, {"tokens": denom}
 
 
+def softmax_xent_loss_mutable(params, model_state, batch, rng, apply_fn):
+    """Classification loss for stateful models (BatchNorm): threads the
+    mutable collections through and returns the updated ones in aux."""
+    x = batch.get("x", batch.get("image"))
+    labels = batch.get("label", batch.get("y"))
+    variables = {"params": params, **model_state}
+    logits, updates = apply_fn(
+        variables, x, train=True, mutable=list(model_state.keys()),
+        rngs={"dropout": rng} if rng is not None else None,
+    )
+    loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+    acc = jnp.mean(jnp.argmax(logits, -1) == labels)
+    return loss, {"accuracy": acc, "model_state": updates}
+
+
+def seq2seq_loss(params, batch, rng, apply_fn):
+    """Teacher-forced MT loss: predict tgt[t+1] from src + tgt[<=t];
+    target positions equal to 0 are treated as padding."""
+    src, tgt = batch["src"], batch["tgt"]
+    logits = apply_fn(params, src, tgt[:, :-1])
+    targets = tgt[:, 1:]
+    losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    mask = (targets != 0).astype(losses.dtype)
+    loss = (losses * mask).sum() / jnp.maximum(mask.sum(), 1)
+    return loss, {"tokens": mask.sum()}
+
+
 def mse_loss(params, batch, rng, apply_fn):
     x = batch.get("x")
     y = batch.get("y", batch.get("label"))

@@ -1,0 +1,126 @@
+"""Metrics / observability (SURVEY.md §5): structured JSONL metrics with
+throughput and MFU accounting — the BASELINE.json:2 headline numbers
+(images/sec/chip, tokens/sec/chip) made measurable.
+
+MFU honesty rule (SURVEY.md §7 hard part #4): record both the raw
+throughput and the model-flops assumptions used for the MFU conversion.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+import time
+from typing import Any, IO
+
+import jax
+
+# Peak dense matmul TFLOP/s per chip by device-kind substring (bf16).
+# Public spec-sheet numbers for each generation.
+PEAK_TFLOPS = {
+    "v5 lite": 197.0,
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v4": 275.0,
+    "v6": 918.0,
+    "cpu": 0.5,  # nominal, so CPU-sim MFU numbers are obviously synthetic
+}
+
+
+def peak_flops_per_chip(device_kind: str | None = None) -> float:
+    dk = (device_kind or jax.devices()[0].device_kind).lower()
+    for k, v in PEAK_TFLOPS.items():
+        if k in dk:
+            return v * 1e12
+    return 100e12
+
+
+def transformer_step_flops(n_params: int, tokens_per_batch: int) -> float:
+    """Standard 6ND approximation: fwd+bwd FLOPs per step for a dense
+    decoder with N params on D tokens.  With remat add ~1 extra forward
+    (8ND) — callers pass the multiplier they actually run with."""
+    return 6.0 * n_params * tokens_per_batch
+
+
+@dataclasses.dataclass
+class Throughput:
+    items_per_sec: float
+    items_per_sec_per_chip: float
+    step_time_s: float
+    mfu: float | None = None
+
+
+class MetricsLogger:
+    """JSONL metrics sink + rolling throughput meter.
+
+    Writes one JSON object per log call: step, loss/aux, step_time,
+    items/sec/chip, MFU when flops-per-step is known.  Host-0 only under
+    multi-host.
+    """
+
+    def __init__(
+        self,
+        path: str | None = None,
+        *,
+        items_name: str = "items",
+        flops_per_step: float | None = None,
+        console: bool = True,
+        console_every: int = 10,
+    ):
+        self.path = path
+        self._file: IO | None = open(path, "a") if path else None
+        self.items_name = items_name
+        self.flops_per_step = flops_per_step
+        self.console = console and jax.process_index() == 0
+        self.console_every = console_every
+        self._t_last: float | None = None
+        self._peak = peak_flops_per_chip()
+        self._n_chips = jax.device_count()
+
+    def start_step(self) -> None:
+        self._t_last = time.perf_counter()
+
+    def log_step(self, step: int, metrics: dict, items_per_step: int) -> dict:
+        now = time.perf_counter()
+        dt = (now - self._t_last) if self._t_last is not None else float("nan")
+        self._t_last = now
+        record: dict[str, Any] = {
+            "step": step,
+            "time": time.time(),
+            "step_time_s": dt,
+            f"{self.items_name}_per_sec": items_per_step / dt if dt else None,
+            f"{self.items_name}_per_sec_per_chip": (
+                items_per_step / dt / self._n_chips if dt else None
+            ),
+        }
+        if self.flops_per_step and dt and dt == dt:
+            record["mfu"] = self.flops_per_step / dt / (
+                self._peak * self._n_chips
+            )
+            record["flops_per_step"] = self.flops_per_step
+        for k, v in metrics.items():
+            if k == "model_state":
+                continue
+            try:
+                record[k] = float(v)
+            except (TypeError, ValueError):
+                pass
+        if self._file:
+            self._file.write(json.dumps(record) + "\n")
+            self._file.flush()
+        if self.console and step % self.console_every == 0:
+            parts = [f"step {step:5d}"]
+            if "loss" in record:
+                parts.append(f"loss {record['loss']:.4f}")
+            ips = record.get(f"{self.items_name}_per_sec_per_chip")
+            if ips:
+                parts.append(f"{ips:,.0f} {self.items_name}/s/chip")
+            if "mfu" in record:
+                parts.append(f"MFU {record['mfu']:.1%}")
+            print("  ".join(parts), file=sys.stderr)
+        return record
+
+    def close(self) -> None:
+        if self._file:
+            self._file.close()

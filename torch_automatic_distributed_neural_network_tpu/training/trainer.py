@@ -1,0 +1,142 @@
+"""Training loop with metrics, checkpointing and debug guards (SURVEY.md §5).
+
+The loop is deliberately thin: the jitted AutoDistribute step is the hot
+path; everything here runs on the host between dispatches and touches
+device data as rarely as possible (loss fetch every ``log_every`` steps).
+
+Guards replacing the reference-world sanitizers in a single-controller
+model (SURVEY.md §5 'race detection'):
+
+- NaN/Inf loss detection with a configurable action (raise/warn);
+- cross-host parameter-divergence check every ``divergence_every`` steps
+  (hash of params compared across hosts — catches drifting hosts, the
+  single-controller analog of a NCCL desync);
+- deterministic-seed assertion: the state rng is derived from the step
+  counter, so restarts reproduce.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, Iterable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from typing import TYPE_CHECKING
+
+from .checkpoint import CheckpointManager, restore_or_init
+from .metrics import MetricsLogger
+
+if TYPE_CHECKING:  # runtime import would be circular (core -> training)
+    from ..core import AutoDistribute, TrainState
+
+
+@dataclasses.dataclass
+class TrainerConfig:
+    steps: int = 1000
+    log_every: int = 10
+    ckpt_every: int = 0  # 0 = no checkpointing
+    nan_action: str = "raise"  # 'raise' | 'warn' | 'ignore'
+    divergence_every: int = 0  # 0 = off; N = check params hash every N
+
+
+class Trainer:
+    def __init__(
+        self,
+        ad: "AutoDistribute",
+        cfg: TrainerConfig = TrainerConfig(),
+        *,
+        metrics: MetricsLogger | None = None,
+        ckpt: CheckpointManager | None = None,
+        items_per_step: int | None = None,
+        run_config: dict | None = None,
+    ):
+        self.ad = ad
+        self.cfg = cfg
+        self.metrics = metrics
+        self.ckpt = ckpt
+        self.items_per_step = items_per_step
+        self.run_config = run_config
+
+    def fit(
+        self,
+        data: Iterable[Any],
+        *,
+        rng: jax.Array | None = None,
+        state: "TrainState | None" = None,
+    ) -> "TrainState":
+        cfg = self.cfg
+        data_iter = iter(data)
+        first = next(data_iter)
+        if state is None:
+            rng = rng if rng is not None else jax.random.key(0)
+            state, resumed = restore_or_init(self.ad, self.ckpt, rng, first)
+            start = int(state.step)
+            if resumed and jax.process_index() == 0:
+                print(f"resumed from step {start}")
+        else:
+            start = int(state.step)
+
+        if self.metrics:
+            self.metrics.start_step()
+        batch = first
+        for i in range(start, cfg.steps):
+            state, step_metrics = self.ad.step(state, batch)
+            if i + 1 < cfg.steps:
+                batch = next(data_iter)
+            if cfg.log_every and (i % cfg.log_every == 0 or i == cfg.steps - 1):
+                self._guard_nan(step_metrics, i)
+                if self.metrics:
+                    self.metrics.log_step(
+                        i, step_metrics, self.items_per_step or 0
+                    )
+            if cfg.divergence_every and i % cfg.divergence_every == 0:
+                self._guard_divergence(state, i)
+            if self.ckpt and cfg.ckpt_every and (i + 1) % cfg.ckpt_every == 0:
+                self.ckpt.save(i + 1, state, config=self.run_config)
+        if self.ckpt and cfg.ckpt_every:
+            if self.ckpt.latest_step() != cfg.steps:
+                self.ckpt.save(cfg.steps, state, config=self.run_config,
+                               force=True)
+            self.ckpt.wait()
+        return state
+
+    # -- guards -------------------------------------------------------------
+
+    def _guard_nan(self, metrics: dict, step: int) -> None:
+        if self.cfg.nan_action == "ignore":
+            return
+        loss = metrics.get("loss")
+        if loss is None:
+            return
+        val = float(loss)
+        if math.isfinite(val):
+            return
+        msg = f"Non-finite loss {val} at step {step}"
+        if self.cfg.nan_action == "raise":
+            raise FloatingPointError(msg)
+        import warnings
+
+        warnings.warn(msg)
+
+    def _guard_divergence(self, state: "TrainState", step: int) -> None:
+        """Cross-host param-hash agreement check (multi-host only)."""
+        if jax.process_count() == 1:
+            return
+        local = np.asarray(
+            jax.tree.reduce(
+                lambda a, b: a + b,
+                jax.tree.map(lambda x: jnp.sum(jnp.abs(x.astype(jnp.float32))),
+                             state.params),
+            )
+        )
+        from jax.experimental import multihost_utils
+
+        gathered = multihost_utils.process_allgather(local)
+        if not np.allclose(gathered, gathered[0], rtol=1e-6):
+            raise RuntimeError(
+                f"Parameter divergence across hosts at step {step}: {gathered}"
+            )

@@ -1,0 +1,50 @@
+"""Debug guards (SURVEY.md §5 'race detection / sanitizers').
+
+In the single-controller GSPMD model there are no hand-written comm
+threads to race — the guards that replace TSAN/NCCL-debug are numeric:
+NaN detection, finite-param assertions, and cross-host divergence checks
+(the latter lives in training.trainer.Trainer._guard_divergence).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@contextlib.contextmanager
+def nan_debugging():
+    """Enable jax_debug_nans inside the block (forces sync execution —
+    use for debugging only, not production steps)."""
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", True)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)
+
+
+def assert_tree_finite(tree, name: str = "tree") -> None:
+    """Host-side check that every leaf is finite; raises with the offending
+    paths listed."""
+    bad = []
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in flat:
+        arr = np.asarray(leaf)
+        if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+            bad.append(jax.tree_util.keystr(path))
+    if bad:
+        raise FloatingPointError(f"Non-finite values in {name}: {bad}")
+
+
+def tree_hash(tree) -> float:
+    """Cheap content hash (abs-sum) of a pytree, device-computed."""
+    return float(
+        jax.tree.reduce(
+            lambda a, b: a + b,
+            jax.tree.map(lambda x: jnp.sum(jnp.abs(x.astype(jnp.float32))), tree),
+        )
+    )

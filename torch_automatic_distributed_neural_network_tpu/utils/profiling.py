@@ -1,0 +1,57 @@
+"""Tracing / profiling hooks (SURVEY.md §5).
+
+TPU-native: ``jax.profiler`` TensorBoard traces (XLA ops + ICI comm lanes)
+and compiled-program cost analysis for MFU accounting — replaces the
+reference world's torch profiler/nvprof path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Capture a TensorBoard trace of everything inside the block::
+
+        with profiling.trace("/tmp/trace"):
+            for _ in range(10):
+                state, _ = ad.step(state, batch)
+    """
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region that shows up on the trace timeline."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def compiled_flops(fn, *args, **kwargs) -> float | None:
+    """FLOP estimate for a jitted callable from XLA's cost analysis.
+
+    Returns None when the backend doesn't expose cost analysis (e.g. some
+    experimental platforms); callers fall back to analytic 6ND estimates.
+    """
+    try:
+        compiled = fn.lower(*args, **kwargs).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, list):  # some backends return one dict per device
+            cost = cost[0]
+        return float(cost.get("flops", 0.0)) or None
+    except Exception:
+        return None
+
+
+def memory_stats(device: Any | None = None) -> dict | None:
+    dev = device or jax.devices()[0]
+    try:
+        return dev.memory_stats()
+    except Exception:
+        return None
