@@ -8,12 +8,11 @@ measured MFU / the 40%-MFU north-star target (BASELINE.json:5), so 1.0
 means "hit the target".  Everything else goes to stderr.
 
 Flags (key=value):
-    model=medium|small|large|1p3b   seq=1024  batch=8  steps=20  strategy=auto
+    model=medium|small|large|1p3b   seq=1024  batch=8  steps=50  strategy=auto
     mode=gpt2|resnet|collectives
 """
 
 import json
-import statistics
 import sys
 import time
 
@@ -22,9 +21,47 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def readback_overhead_s():
+    """One host<->device round trip, measured.
+
+    On the tunneled axon TPU, ``block_until_ready`` does NOT synchronize
+    (verified live: a chained 20x 8k-matmul 'completed' in 0.2ms).  The
+    only reliable fence is a host readback, which costs ~68ms through the
+    tunnel — so all step timing here chains N steps (state feeds state),
+    forces ONE readback, and subtracts this measured overhead.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    x = jax.jit(lambda: jnp.zeros(()))()
+    bump = jax.jit(lambda v: v + 1)
+    float(bump(x))  # warm: trace + compile outside the timed window
+    t0 = time.perf_counter()
+    for _ in range(5):
+        float(bump(x))
+    return (time.perf_counter() - t0) / 5
+
+
+def timed_chain(step, state, batches):
+    """Run the step over every batch (async dispatch chains on state) and
+    fence once at the end; returns (state, seconds per step)."""
+    if not batches:
+        raise ValueError("timed_chain needs at least one batch (steps >= 1)")
+    overhead = readback_overhead_s()
+    t0 = time.perf_counter()
+    metrics = None
+    for b in batches:
+        state, metrics = step(state, b)
+    _ = float(metrics["loss"])  # the one true fence
+    total = time.perf_counter() - t0 - overhead
+    return state, max(total, 1e-9) / len(batches)
+
+
 def parse_args():
     args = {
-        "model": "medium", "seq": 1024, "batch": 8, "steps": 20,
+        # 50+ steps: short chains under-measure through the axon tunnel
+        # (10-step chains reported impossible >100% MFU; 50 steps is stable)
+        "model": "medium", "seq": 1024, "batch": 8, "steps": 50,
         "strategy": "auto", "mode": "gpt2",
     }
     for item in sys.argv[1:]:
@@ -68,31 +105,25 @@ def bench_gpt2(args):
     t0 = time.perf_counter()
     state = ad.init(jax.random.key(0), data.batch(0))
     b = data.batch(0)
-    state, _ = ad.step(state, b)  # compile
-    jax.block_until_ready(state.params)
+    state, m = ad.step(state, b)  # compile
+    float(m["loss"])
     log(f"compile+init: {time.perf_counter()-t0:.1f}s "
         f"plan={ad.plan.strategy} mesh={tad.mesh_degrees(ad.plan.mesh)}")
 
     # warmup
     for i in range(2):
-        state, _ = ad.step(state, data.batch(i))
-    jax.block_until_ready(state.params)
+        state, m = ad.step(state, data.batch(i))
+    float(m["loss"])
 
-    times = []
     batches = [data.batch(i) for i in range(steps)]
-    for b in batches:
-        t = time.perf_counter()
-        state, _ = ad.step(state, b)
-        jax.block_until_ready(state.step)
-        times.append(time.perf_counter() - t)
-    dt = statistics.median(times)
+    state, dt = timed_chain(ad.step, state, batches)
     n_chips = jax.device_count()
     tokens_per_step = batch * seq
     tps_chip = tokens_per_step / dt / n_chips
     flops_mult = 8.0 / 6.0 if ad.plan.remat else 1.0
     flops = transformer_step_flops(mcfg.num_params(), tokens_per_step) * flops_mult
     mfu = flops / dt / (peak_flops_per_chip() * n_chips)
-    log(f"median step {dt*1e3:.1f}ms  {tps_chip:,.0f} tokens/s/chip  "
+    log(f"mean step {dt*1e3:.1f}ms  {tps_chip:,.0f} tokens/s/chip  "
         f"MFU {mfu:.1%} (remat={'on' if ad.plan.remat else 'off'})")
     return {
         "metric": f"gpt2_{args['model']}_tokens_per_sec_per_chip",
@@ -134,18 +165,12 @@ def bench_resnet(args):
         strategy="dp",
     )
     state = ad.init(jax.random.key(0), data.batch(0))
-    state, _ = ad.step(state, data.batch(0))
-    jax.block_until_ready(state.step)
-    times = []
+    state, m = ad.step(state, data.batch(0))
+    float(m["loss"])
     batches = [data.batch(i) for i in range(steps)]
-    for b in batches:
-        t = time.perf_counter()
-        state, _ = ad.step(state, b)
-        jax.block_until_ready(state.step)
-        times.append(time.perf_counter() - t)
-    dt = statistics.median(times)
+    state, dt = timed_chain(ad.step, state, batches)
     ips_chip = batch / dt / jax.device_count()
-    log(f"median step {dt*1e3:.1f}ms  {ips_chip:,.0f} images/s/chip")
+    log(f"mean step {dt*1e3:.1f}ms  {ips_chip:,.0f} images/s/chip")
     return {
         "metric": "resnet50_images_per_sec_per_chip",
         "value": round(ips_chip, 1),

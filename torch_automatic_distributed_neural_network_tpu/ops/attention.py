@@ -63,6 +63,20 @@ def xla_attention(
     return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
 
 
+def _flash_ok(q: jax.Array, k: jax.Array, mask) -> bool:
+    """Auto-dispatch gate for the Pallas flash kernel: TPU backend, no
+    explicit mask, a sequence long enough that block streaming wins
+    (measured crossover on v5e is well below 512)."""
+    if mask is not None:
+        return False
+    if q.shape[1] < 512 or q.shape[1] != k.shape[1]:
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
 def attention(
     q: jax.Array,
     k: jax.Array,
@@ -97,6 +111,8 @@ def attention(
                     impl = "ulysses"
                 else:
                     impl = "ring"
+        elif _flash_ok(q, k, mask):
+            impl = "flash"
         else:
             impl = "xla"
 
@@ -105,6 +121,47 @@ def attention(
     if impl == "flash":
         from .flash_attention import flash_attention
 
+        if mask is not None:
+            raise NotImplementedError(
+                "flash attention does not take explicit masks (causal only)"
+            )
+        if ctx is not None and cp > 1:
+            raise NotImplementedError(
+                "flash attention cannot span a sharded sequence axis — "
+                "use impl='ring' or 'ulysses' (or 'auto') under context "
+                "parallelism"
+            )
+        if ctx is not None and (ctx.present_batch_axes
+                                or ctx.degrees.get(ctx.head_axis, 1) > 1):
+            # Inside a GSPMD-jitted step on a nontrivial mesh the Mosaic
+            # custom call is not partitionable — run it under shard_map
+            # over the batch (and head, under TP) axes, which is exact:
+            # attention is independent per batch element and per head.
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            tp = ctx.degrees.get(ctx.head_axis, 1)
+            head_axis = ctx.head_axis if tp > 1 else None
+            if tp > 1 and q.shape[2] % tp:
+                # head count indivisible by the tensor degree — the
+                # einsum path under GSPMD is the safe fallback
+                return xla_attention(q, k, v, causal=causal)
+            if k.shape[2] != q.shape[2]:
+                # GQA: broadcast K/V heads first so all three operands
+                # shard evenly on the head axis (n_kv_heads may not
+                # divide the tensor degree)
+                rep = q.shape[2] // k.shape[2]
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            spec = P(ctx.batch_spec_entry(), None, head_axis, None)
+            fn = shard_map(
+                functools.partial(flash_attention, causal=causal),
+                mesh=ctx.mesh,
+                in_specs=(spec, spec, spec),
+                out_specs=spec,
+                check_vma=False,
+            )
+            return fn(q, k, v)
         return flash_attention(q, k, v, causal=causal)
     if impl in ("ring", "ulysses"):
         if mask is not None:

@@ -18,7 +18,6 @@ with the reference's NCCL benchmarks: for allreduce on n devices,
 from __future__ import annotations
 
 import dataclasses
-import statistics
 import time
 from functools import partial
 from typing import Callable, Sequence
@@ -157,15 +156,36 @@ def bench_collective(
 
     x = jnp.ones((per_dev * n,), dtype)
     x = jax.device_put(x, NamedSharding(mesh, P(axis)))
-    jitted = jax.jit(run)
+
+    # Timing is fenced by a single host readback: on the tunneled axon
+    # platform block_until_ready does not synchronize, so each sample
+    # chains `iters` collectives inside one jit (inputs perturbed per
+    # iteration to defeat CSE) and reads one scalar back.
+    @jax.jit
+    def run_n(x):
+        def body(i, acc):
+            # O(1) perturbation: serializing data dependency on acc
+            # without a full-buffer elementwise pass or dtype promotion
+            xx = x.at[0].add((acc * 0).astype(x.dtype))
+            y = run(xx)
+            return acc + y.reshape(-1)[0].astype(jnp.float32)
+        return jax.lax.fori_loop(0, iters, body, jnp.float32(0))
+
+    @jax.jit
+    def fence(x):
+        return x.reshape(-1)[0].astype(jnp.float32)
+
     for _ in range(warmup):
-        jitted(x).block_until_ready()
-    times = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        jitted(x).block_until_ready()
-        times.append(time.perf_counter() - t0)
-    t = statistics.median(times)
+        float(run_n(x))
+    float(fence(x))  # warm: trace+compile outside the timed window
+    t0 = time.perf_counter()
+    for _ in range(3):
+        float(fence(x))
+    overhead = (time.perf_counter() - t0) / 3
+    t0 = time.perf_counter()
+    total = float(run_n(x))
+    assert total == total
+    t = max(time.perf_counter() - t0 - overhead, 1e-9) / iters
     # NCCL-tests convention: bandwidth is computed from the PER-RANK buffer
     # size, not the global array size.
     nbytes = per_dev * itemsize
