@@ -1,0 +1,220 @@
+"""Pipeline parallelism tests (SURVEY.md §2.2 'PP', §4 CPU-sim tier).
+
+Oracle pattern (SURVEY.md §3.5): the sequential single-program run is the
+ground truth; the pipelined program must match it numerically — forward,
+gradients, and the full AutoDistribute loss trajectory.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+import torch_automatic_distributed_neural_network_tpu as tad
+from torch_automatic_distributed_neural_network_tpu.models import (
+    DecoderLM,
+    TransformerConfig,
+)
+from torch_automatic_distributed_neural_network_tpu.parallel import pipeline
+from torch_automatic_distributed_neural_network_tpu.training import (
+    next_token_loss,
+)
+
+TINY = TransformerConfig(
+    vocab_size=512,
+    d_model=64,
+    n_layers=4,
+    n_heads=4,
+    max_seq_len=32,
+    dtype=jnp.float32,  # exact parity checks
+)
+
+
+def _mesh(devs, shape, names):
+    return Mesh(np.array(devs).reshape(shape), names)
+
+
+class TestSpmdPipeline:
+    def test_forward_and_grad_parity(self, devices8):
+        mesh = _mesh(devices8[:4], (4,), ("pipe",))
+        L, D, M, MB = 8, 16, 4, 2
+        W = jax.random.normal(jax.random.key(0), (L, D, D)) * 0.1
+        x = jax.random.normal(jax.random.key(1), (M, MB, D))
+
+        def stage_fn(w_stack, h):
+            def body(c, w):
+                return jnp.tanh(c @ w), None
+
+            return jax.lax.scan(body, h, w_stack)[0]
+
+        pipe = shard_map(
+            lambda w, mbs: pipeline.spmd_pipeline(
+                stage_fn, w, mbs, n_stages=4, axis_name="pipe"
+            ),
+            mesh=mesh,
+            in_specs=(P("pipe"), P()),
+            out_specs=P(),
+        )
+
+        ref = x
+        for i in range(L):
+            ref = jnp.tanh(ref @ W[i])
+        out = jax.jit(pipe)(W, x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+        g_pipe = jax.jit(jax.grad(lambda w: jnp.sum(pipe(w, x) ** 2)))(W)
+
+        def seq_loss(w):
+            h = x
+            for i in range(L):
+                h = jnp.tanh(h @ w[i])
+            return jnp.sum(h**2)
+
+        g_ref = jax.jit(jax.grad(seq_loss))(W)
+        np.testing.assert_allclose(
+            np.asarray(g_pipe), np.asarray(g_ref), atol=1e-5
+        )
+
+    def test_with_data_axis(self, devices8):
+        """pipe x data mesh: batch sharded over data, pipeline over pipe."""
+        mesh = _mesh(devices8, (2, 4), ("pipe", "data"))
+        L, D, M, B = 4, 8, 2, 8
+        W = jax.random.normal(jax.random.key(0), (L, D, D)) * 0.1
+        x = jax.random.normal(jax.random.key(1), (M, B, D))
+
+        def stage_fn(w_stack, h):
+            return jax.lax.scan(
+                lambda c, w: (jnp.tanh(c @ w), None), h, w_stack
+            )[0]
+
+        pipe = shard_map(
+            lambda w, mbs: pipeline.spmd_pipeline(
+                stage_fn, w, mbs, n_stages=2, axis_name="pipe"
+            ),
+            mesh=mesh,
+            in_specs=(P("pipe"), P(None, "data")),
+            out_specs=P(None, "data"),
+        )
+        ref = x
+        for i in range(L):
+            ref = jnp.tanh(ref @ W[i])
+        out = jax.jit(pipe)(W, x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+    def test_stage_shape_mismatch_raises(self, devices8):
+        mesh = _mesh(devices8[:2], (2,), ("pipe",))
+        W = jnp.zeros((2, 4, 8))
+        x = jnp.zeros((2, 2, 4))
+
+        def bad_stage(w, h):  # changes the trailing dim
+            return h @ w[0]
+
+        pipe = shard_map(
+            lambda w, mbs: pipeline.spmd_pipeline(
+                bad_stage, w, mbs, n_stages=2, axis_name="pipe"
+            ),
+            mesh=mesh,
+            in_specs=(P("pipe"), P()),
+            out_specs=P(),
+        )
+        with pytest.raises(ValueError, match="preserve activation"):
+            jax.jit(pipe)(W, x)
+
+    def test_bubble_fraction(self):
+        assert pipeline.bubble_fraction(1, 8) == 0.0
+        assert pipeline.bubble_fraction(4, 4) == pytest.approx(3 / 7)
+
+
+class TestPipelinedApply:
+    def test_logits_parity_with_model(self, devices8):
+        """Pipelined apply == plain model.apply (drift guard for the
+        mirrored embed/head glue in make_pipelined_apply)."""
+        mesh = _mesh(devices8[:2], (2,), ("pipe",))
+        model = DecoderLM(TINY)
+        tokens = jax.random.randint(jax.random.key(0), (4, 16), 0, 512)
+        variables = model.init(jax.random.key(1), tokens)
+        ref = model.apply(variables, tokens)
+        papply = pipeline.make_pipelined_apply(model, mesh, n_microbatches=2)
+        out = jax.jit(papply)(variables, tokens)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+
+    def test_rmsnorm_rope_untied_variant(self, devices8):
+        mesh = _mesh(devices8[:4], (4,), ("pipe",))
+        cfg = TransformerConfig(
+            vocab_size=256, d_model=64, n_layers=4, n_heads=4,
+            n_kv_heads=2, max_seq_len=32, norm="rmsnorm", act="swiglu",
+            pos="rope", tie_embeddings=False, dtype=jnp.float32,
+        )
+        model = DecoderLM(cfg)
+        tokens = jax.random.randint(jax.random.key(0), (4, 16), 0, 256)
+        variables = model.init(jax.random.key(1), tokens)
+        ref = model.apply(variables, tokens)
+        papply = pipeline.make_pipelined_apply(model, mesh, n_microbatches=4)
+        out = jax.jit(papply)(variables, tokens)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+
+    def test_rejects_indivisible_layers(self, devices8):
+        mesh = _mesh(devices8[:4], (4,), ("pipe",))
+        cfg = TransformerConfig(
+            vocab_size=64, d_model=32, n_layers=6, n_heads=2, max_seq_len=16
+        )
+        with pytest.raises(ValueError, match="not divisible"):
+            pipeline.make_pipelined_apply(DecoderLM(cfg), mesh)
+
+
+class TestAutoDistributePipeline:
+    def test_loss_trajectory_matches_dp(self, devices8):
+        """pipe=2 x data=4 matches pure-DP — the §3.5 oracle."""
+        tokens = np.asarray(
+            jax.random.randint(jax.random.key(9), (8, 17), 0, 512)
+        )
+        batch = {"input_ids": tokens}
+
+        def make(**kw):
+            ad = tad.AutoDistribute(
+                DecoderLM(TINY),
+                optimizer=optax.sgd(0.1),
+                loss_fn=next_token_loss,
+                **kw,
+            )
+            state = ad.init(jax.random.key(0), batch)
+            losses = []
+            for _ in range(4):
+                state, m = ad.step(state, batch)
+                losses.append(float(m["loss"]))
+            return losses
+
+        ref = make(strategy="dp")
+        got = make(strategy="dp", pipeline_stages=2, microbatches=2)
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+    def test_plan_shards_layer_stack_on_pipe(self, devices8):
+        ad = tad.AutoDistribute(
+            DecoderLM(TINY),
+            optimizer=optax.sgd(0.1),
+            loss_fn=next_token_loss,
+            strategy="dp",
+            pipeline_stages=4,
+            microbatches=2,
+        )
+        batch = {"input_ids": np.zeros((8, 17), np.int32)}
+        plan = ad.build_plan(jax.random.key(0), batch)
+        assert plan.mesh.shape["pipe"] == 4
+        flat = jax.tree_util.tree_flatten_with_path(
+            plan.param_specs, is_leaf=lambda x: isinstance(x, P)
+        )[0]
+        layer_specs = [
+            spec
+            for path, spec in flat
+            if "layers" in "/".join(str(getattr(k, "key", k)) for k in path)
+        ]
+        assert layer_specs and all(
+            spec[0] == "pipe" for spec in layer_specs
+        )

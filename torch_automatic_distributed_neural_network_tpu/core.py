@@ -102,6 +102,8 @@ class AutoDistribute:
         devices: Sequence[jax.Device] | None = None,
         seq_parallel: int = 1,
         seq_impl: str = "auto",
+        pipeline_stages: int = 1,
+        microbatches: int = 8,
     ):
         if model is None and init_fn is None:
             raise ValueError("Provide a model or an init_fn")
@@ -121,6 +123,11 @@ class AutoDistribute:
             )
         self._seq_parallel = seq_parallel
         self._seq_impl = seq_impl
+        if pipeline_stages > 1 and seq_parallel > 1:
+            raise ValueError("pipeline_stages and seq_parallel are exclusive (v1)")
+        self._pipeline_stages = pipeline_stages
+        self._microbatches = microbatches
+        self._pipelined_apply = None
         self._pctx = None
         self.plan: planner_mod.ShardPlan | None = None
         self._step_fn = None
@@ -152,12 +159,31 @@ class AutoDistribute:
             devices=self._devices,
             remat=self._remat,
             seq=self._seq_parallel,
+            pipe=self._pipeline_stages,
         )
         from .parallel import context as pctx
 
         self._pctx = pctx.ParallelContext(
             mesh=self.plan.mesh, seq_impl=self._seq_impl
         )
+        if self._pipeline_stages > 1:
+            if self._has_model_state:
+                raise ValueError(
+                    "pipeline parallelism does not support stateful models "
+                    "(batch stats) yet"
+                )
+            from .parallel import pipeline as pipe_mod
+
+            # GPipe over the scanned layer stack; remat is applied inside
+            # the stage loop (explicit remat= wins over the model cfg), so
+            # disable the outer loss-level checkpoint.
+            self._pipelined_apply = pipe_mod.make_pipelined_apply(
+                self.model,
+                self.plan.mesh,
+                n_microbatches=self._microbatches,
+                remat=self._remat,
+            )
+            self.plan.remat = False
         return self.plan
 
     @property
@@ -236,7 +262,7 @@ class AutoDistribute:
             for ax in axes if isinstance(axes, tuple) else (axes,):
                 if ax:
                     dp *= degrees.get(ax, 1)
-        if dp <= 1:
+        if dp <= 1 and self._pipeline_stages <= 1:
             return
         for leaf in jax.tree.leaves(batch):
             shape = getattr(leaf, "shape", ())
@@ -249,6 +275,16 @@ class AutoDistribute:
                     f"data-parallel degree {dp} (mesh {degrees}). Increase "
                     f"the batch size or reduce the data/fsdp mesh axes."
                 )
+            if (
+                n is not None
+                and self._pipeline_stages > 1
+                and (n // dp) % self._microbatches
+            ):
+                raise ValueError(
+                    f"Per-device batch {n // dp} is not divisible by "
+                    f"microbatches={self._microbatches} (pipeline). Adjust "
+                    "batch size or microbatches."
+                )
 
     # -- the train step -----------------------------------------------------
 
@@ -260,7 +296,7 @@ class AutoDistribute:
             # model_state and may return a 'model_state' key in aux.
             out = self._loss_fn(params, model_state, batch, rng, self._apply_fn)
         else:
-            apply = self._apply_fn
+            apply = self._pipelined_apply or self._apply_fn
             wrapped = (
                 (lambda p, *a, **k: apply({"params": p}, *a, **k))
                 if apply is not None

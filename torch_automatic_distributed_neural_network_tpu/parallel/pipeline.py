@@ -1,0 +1,259 @@
+"""Pipeline parallelism (SURVEY.md §2.2 'PP'; §7 phase 9).
+
+GPipe-style schedule under the single-controller GSPMD model (SURVEY.md §7
+hard part #5): the whole pipeline is ONE compiled program — a `lax.scan`
+microbatch loop inside a `shard_map` region, with activations hopping to
+the next stage over the ICI ring via `ppermute`.
+
+Layout: the decoder's scanned layer stack gives parameters a leading
+``[n_layers, ...]`` dim (models/transformer_core.py:192-199).  Sharding
+that dim over the ``pipe`` mesh axis hands each pipe rank a contiguous
+block of ``n_layers / n_stages`` layers — its stage.  Inside the stage,
+layers run under a local `lax.scan`; between stages, the activation is
+`ppermute`d one hop.  Reverse-mode AD through the scan+ppermute yields the
+GPipe backward schedule automatically (full forward, then full backward,
+per microbatch) — no hand-written backward pass.
+
+Schedule cost: ``M + S - 1`` iterations for M microbatches on S stages;
+bubble fraction ``(S-1)/(M+S-1)``.  Every rank computes every iteration
+(bubble iterations compute on garbage and are masked out) — uniform SPMD
+compute, which is what keeps this a single XLA program.
+
+Composability (v1): pipe × data/fsdp.  Tensor parallelism inside a
+shard_map stage would need manual collectives — planned, not yet wired.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .. import topology as topo_mod
+
+
+def _to_varying(x, axis_name: str):
+    """Cast to device-varying along ``axis_name`` (no-op data movement)."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, (axis_name,), to="varying")
+    return jax.lax.pvary(x, (axis_name,))
+
+
+def spmd_pipeline(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    microbatches: jax.Array,
+    *,
+    n_stages: int,
+    axis_name: str = "pipe",
+) -> jax.Array:
+    """GPipe microbatch loop.  MUST run inside `shard_map` with
+    ``stage_params`` sharded on ``axis_name`` (leading dim) and
+    ``microbatches`` of local shape ``[M, mb, ...]`` replicated along it.
+
+    ``stage_fn(local_stage_params, x) -> y`` applies one stage's layers;
+    activation shape/dtype must be preserved (transformer blocks are).
+    Returns ``[M, mb, ...]`` outputs, replicated along ``axis_name``.
+    """
+    S = n_stages
+    M = microbatches.shape[0]
+    stage = jax.lax.axis_index(axis_name)
+
+    # mark loop state as device-varying along the pipe axis so the scan
+    # carry type is stable (jax vma tracking inside shard_map)
+    microbatches = _to_varying(microbatches, axis_name)
+    mb_aval = jax.eval_shape(lambda x: x[0], microbatches)
+    out_aval = jax.eval_shape(stage_fn, stage_params, mb_aval)
+    if out_aval.shape != mb_aval.shape or out_aval.dtype != mb_aval.dtype:
+        raise ValueError(
+            f"pipeline stage_fn must preserve activation shape/dtype; "
+            f"got {mb_aval.shape}/{mb_aval.dtype} -> "
+            f"{out_aval.shape}/{out_aval.dtype}"
+        )
+
+    # zeros_like inherits every varying axis of the (cast) microbatches —
+    # e.g. 'data' when the batch is also sharded — keeping scan carry types
+    # stable no matter which other mesh axes are in play
+    act0 = jnp.zeros_like(microbatches[0])
+    outputs0 = jnp.zeros_like(microbatches)
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def body(carry, t):
+        act, outputs = carry
+        # stage 0 ingests microbatch t (clamped: bubble iterations redo the
+        # last one and their results are never stored)
+        inp = jnp.where(
+            stage == 0,
+            jax.lax.dynamic_index_in_dim(
+                microbatches, jnp.clip(t, 0, M - 1), 0, keepdims=False
+            ),
+            act,
+        )
+        out = stage_fn(stage_params, inp)
+        # the last stage finishes microbatch t-(S-1) at iteration t
+        out_idx = jnp.clip(t - (S - 1), 0, M - 1)
+        is_done = jnp.logical_and(stage == S - 1, t >= S - 1)
+        cur = jax.lax.dynamic_index_in_dim(outputs, out_idx, 0, keepdims=False)
+        outputs = jax.lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(is_done, out, cur), out_idx, 0
+        )
+        # one ICI hop to the next stage (ring; last->first carries garbage)
+        nxt = jax.lax.ppermute(out, axis_name, perm)
+        return (nxt, outputs), None
+
+    (_, outputs), _ = jax.lax.scan(
+        body, (act0, outputs0), jnp.arange(M + S - 1)
+    )
+    # only the last stage holds real outputs — masked psum broadcasts them
+    # so the shard_map out_spec is replicated along the pipe axis
+    outputs = jax.lax.psum(
+        jnp.where(stage == S - 1, outputs, jnp.zeros_like(outputs)),
+        axis_name,
+    )
+    return outputs
+
+
+# ---------------------------------------------------------------------------
+# DecoderLM integration
+# ---------------------------------------------------------------------------
+
+
+def make_pipelined_apply(
+    model: nn.Module,
+    mesh: Mesh,
+    *,
+    n_microbatches: int = 8,
+    axis_name: str = "pipe",
+    remat: bool | None = None,
+) -> Callable:
+    """Build ``apply(variables, tokens) -> logits`` running ``model``'s
+    layer stack as a GPipe pipeline over ``mesh``'s ``pipe`` axis.
+
+    ``model`` must be a ``DecoderLM`` (models/transformer_core.py) with
+    ``scan_layers=True`` — the scanned stack's leading dim is what the
+    pipeline shards into stages.  Embedding and LM head run outside the
+    shard_map region, replicated across the pipe axis (GSPMD shards them
+    over data/tensor axes as usual); only the O(n_layers) trunk — where
+    the parameters live — is pipelined.
+
+    Mirrors DecoderLM.__call__ (transformer_core.py:168-212); the parity
+    test (tests/test_pipeline.py) pins the two together.
+    """
+    from ..models.transformer_core import DecoderLayer, DecoderLM, make_norm
+
+    if not isinstance(model, DecoderLM):
+        raise TypeError(
+            f"pipeline parallelism needs a DecoderLM-family model "
+            f"(GPT2/Llama); got {type(model).__name__}"
+        )
+    cfg = model.cfg
+    if not cfg.scan_layers:
+        raise ValueError("pipeline parallelism requires cfg.scan_layers=True")
+    if cfg.dropout_rate:
+        raise ValueError(
+            "pipeline v1 does not thread dropout rngs through stages; "
+            "set dropout_rate=0"
+        )
+    S = topo_mod.mesh_degrees(mesh).get(axis_name, 1)
+    if S <= 1:
+        raise ValueError(f"mesh has no {axis_name!r} axis > 1")
+    if cfg.n_layers % S:
+        raise ValueError(
+            f"n_layers={cfg.n_layers} not divisible by {S} pipeline stages"
+        )
+    M = n_microbatches
+
+    layer = DecoderLayer(cfg)
+
+    def one_layer(p, x, positions):
+        return layer.apply({"params": p}, x, positions)
+
+    if cfg.remat if remat is None else remat:
+        one_layer = jax.checkpoint(
+            one_layer,
+            policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+        )
+
+    def stage_fn(stage_params, x):
+        positions = jnp.arange(x.shape[1])[None, :]
+
+        def body(carry, p):
+            return one_layer(p, carry, positions), None
+
+        y, _ = jax.lax.scan(body, x, stage_params)
+        return y
+
+    from ..planner import batch_partition_spec
+    from . import context as pctx
+
+    x_spec = batch_partition_spec(mesh)  # batch on data axes; rest replicated
+
+    def pipe_region(layer_params, x):
+        b_local = x.shape[0]
+        if b_local % M:
+            raise ValueError(
+                f"per-device batch {b_local} not divisible by "
+                f"{M} microbatches"
+            )
+        mbs = x.reshape((M, b_local // M) + x.shape[1:])
+        # drop the ambient ParallelContext: inside this shard_map region
+        # everything is device-local, so attention must not wrap its own
+        # shard_map (ops/attention.py flash path) — with no context the
+        # flash kernel is called directly, which is the right thing here
+        with pctx.use(None):
+            out = spmd_pipeline(
+                stage_fn, layer_params, mbs, n_stages=S, axis_name=axis_name
+            )
+        return out.reshape(x.shape)
+
+    pipe = shard_map(
+        pipe_region,
+        mesh=mesh,
+        in_specs=(P(axis_name), x_spec),
+        out_specs=x_spec,
+    )
+
+    embed = nn.Embed(
+        cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+        embedding_init=nn.initializers.normal(0.02),
+    )
+
+    def apply(variables, tokens, positions=None, mask=None):
+        if positions is not None or mask is not None:
+            raise NotImplementedError(
+                "pipelined apply does not thread custom positions/mask "
+                "through stages yet — use default causal attention"
+            )
+        params = variables["params"] if "params" in variables else variables
+        x = embed.apply({"params": params["embed"]}, tokens)
+        if cfg.pos == "learned":
+            x = x + params["pos_embed"][None, : tokens.shape[1]].astype(
+                cfg.dtype
+            )
+        x = pipe(params["layers"], x)
+        x = make_norm(cfg, "final_norm").apply(
+            {"params": params["final_norm"]}, x
+        )
+        if cfg.tie_embeddings:
+            logits = embed.apply(
+                {"params": params["embed"]},
+                x.astype(jnp.float32),
+                method="attend",
+            )
+        else:
+            logits = nn.Dense(
+                cfg.vocab_size, dtype=jnp.float32, use_bias=False,
+            ).apply({"params": params["lm_head"]}, x)
+        return logits.astype(jnp.float32)
+
+    return apply
+
+
+def bubble_fraction(n_stages: int, n_microbatches: int) -> float:
+    """GPipe bubble overhead: idle fraction of the schedule."""
+    return (n_stages - 1) / (n_microbatches + n_stages - 1)

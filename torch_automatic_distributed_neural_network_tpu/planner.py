@@ -221,12 +221,19 @@ def _hbm_bytes(device_kind: str) -> int:
     return 16 * 2**30
 
 
+# Parameter paths holding a scanned layer stack (leading [n_layers, ...]
+# dim, models/transformer_core.py nn.scan) — the dim pipeline parallelism
+# shards into stages.
+PIPE_STACK_PATTERN = r"(^|/)layers/"
+
+
 def param_spec_tree(
     abstract_params: Any,
     mesh: Mesh,
     strategy: str,
     rules: Sequence[Rule] = TRANSFORMER_RULES,
     fsdp_axes: tuple[str, ...] = ("fsdp",),
+    pipe_stack_pattern: str = PIPE_STACK_PATTERN,
 ) -> Any:
     """Assign a PartitionSpec to every parameter by path+shape.
 
@@ -236,12 +243,21 @@ def param_spec_tree(
     degrees = topo_mod.mesh_degrees(mesh)
     use_tp = strategy in ("tp", "tp_fsdp") and degrees.get("tensor", 1) > 1
     use_fsdp = strategy in ("fsdp", "tp_fsdp") and _axis_size(fsdp_axes, degrees) > 1
+    pipe = degrees.get("pipe", 1)
 
     def assign(keypath, leaf):
         shape = tuple(getattr(leaf, "shape", ()))
         path = path_str(keypath)
         spec: P | None = None
-        if use_tp:
+        if (
+            pipe > 1
+            and re.search(pipe_stack_pattern, path)
+            and shape
+            and shape[0] % pipe == 0
+        ):
+            # leading layer-stack dim -> pipeline stages (parallel/pipeline.py)
+            spec = P("pipe")
+        elif use_tp:
             for rule in rules:
                 if rule.matches(path):
                     spec = _spec_from_rule(rule, shape, degrees)
@@ -323,21 +339,36 @@ def make_plan(
     devices: Sequence[jax.Device] | None = None,
     remat: bool | None = None,
     seq: int = 1,
+    pipe: int = 1,
 ) -> ShardPlan:
     """The planner: abstract params + topology -> ShardPlan.
 
     ``abstract_params`` is any pytree of objects with ``.shape``/``.dtype``
     (e.g. the output of ``jax.eval_shape``).  If ``mesh`` is given the
     strategy is applied on it as-is; otherwise the mesh is built from the
-    chosen/requested strategy.
+    chosen/requested strategy.  ``pipe`` > 1 adds a pipeline axis; layer
+    stacks shard their leading dim onto it (parallel/pipeline.py).
     """
     known = ("auto", "dp", "fsdp", "tp", "tp_fsdp")
     if strategy not in known:
         raise ValueError(f"Unknown strategy {strategy!r}; expected one of {known}")
+    if pipe > 1 and strategy in ("tp", "tp_fsdp"):
+        raise ValueError(
+            "pipeline parallelism composes with dp/fsdp only (v1); "
+            f"strategy {strategy!r} + pipe={pipe} is not supported"
+        )
     topo = topo_mod.detect(devices)
     resolved = strategy
     if mesh is None:
         n = topo.num_devices
+        if seq > 1 and pipe > 1:
+            raise ValueError("seq-parallel + pipeline in one plan: not yet")
+        if pipe > 1:
+            if n % pipe:
+                raise ValueError(
+                    f"pipeline degree {pipe} does not divide {n} devices"
+                )
+            n //= pipe
         if seq > 1:
             if n % seq:
                 raise ValueError(
@@ -350,6 +381,9 @@ def make_plan(
                 abstract_params, dataclasses.replace(topo, num_devices=n),
                 rules,
             )
+            if pipe > 1 and resolved in ("tp", "tp_fsdp"):
+                # v1: pp composes with dp/fsdp only
+                resolved, degrees = "fsdp", {"fsdp": n}
         elif strategy == "dp":
             degrees = {"data": n}
         elif strategy == "fsdp":
@@ -368,8 +402,16 @@ def make_plan(
             raise ValueError(f"Unknown strategy {strategy!r}")
         if seq > 1:
             degrees["seq"] = seq
+        if pipe > 1:
+            degrees["pipe"] = pipe
         mesh = topo_mod.build_mesh(devices=devices, **degrees)
     else:
+        if pipe > 1 and topo_mod.mesh_degrees(mesh).get("pipe", 1) != pipe:
+            raise ValueError(
+                f"pipe={pipe} conflicts with the explicit mesh "
+                f"(its 'pipe' axis is "
+                f"{topo_mod.mesh_degrees(mesh).get('pipe', 1)})"
+            )
         if seq > 1 and topo_mod.mesh_degrees(mesh).get("seq", 1) != seq:
             raise ValueError(
                 f"seq_parallel={seq} conflicts with the explicit mesh "
