@@ -1,0 +1,98 @@
+"""Train a Mixtral-style MoE LM with automatic expert parallelism.
+
+EP is brief-mandated (SURVEY.md §2.2 — no reference config exercises it;
+the reference zoo is dense, BASELINE.json:7-11).  The planner detects the
+expert banks and puts the expert dim on its own mesh axis; GSPMD emits
+the dispatch/combine all_to_all over ICI.
+
+Usage::
+
+    python examples/train_moe.py model.size=nano run.steps=100
+    python examples/train_moe.py parallel.strategy=ep_fsdp
+"""
+
+import dataclasses
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import optax
+
+import torch_automatic_distributed_neural_network_tpu as tad
+from torch_automatic_distributed_neural_network_tpu.data.synthetic import SyntheticLM
+from torch_automatic_distributed_neural_network_tpu.models import MoE, moe_config
+from torch_automatic_distributed_neural_network_tpu.training import (
+    MetricsLogger,
+    moe_next_token_loss,
+)
+from torch_automatic_distributed_neural_network_tpu.utils import config as cfglib
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelCfg:
+    size: str = "nano"
+    seq_len: int = 512
+    vocab_size: int = 32000
+
+
+@dataclasses.dataclass(frozen=True)
+class RunCfg:
+    steps: int = 50
+    batch_size: int = 8
+    lr: float = 3e-4
+    log_every: int = 10
+    metrics_path: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelCfg:
+    strategy: str = "auto"
+
+
+@dataclasses.dataclass(frozen=True)
+class Cfg:
+    model: ModelCfg = ModelCfg()
+    run: RunCfg = RunCfg()
+    parallel: ParallelCfg = ParallelCfg()
+
+
+def main():
+    cfg: Cfg = cfglib.apply_overrides(Cfg(), sys.argv[1:])
+    print(cfglib.to_json(cfg))
+    print(f"devices: {jax.device_count()} x {jax.devices()[0].device_kind}")
+
+    mcfg = moe_config(cfg.model.size, vocab_size=cfg.model.vocab_size,
+                      max_seq_len=cfg.model.seq_len)
+    print(f"MoE {cfg.model.size}: {mcfg.num_params()/1e6:.0f}M total / "
+          f"{mcfg.active_params()/1e6:.0f}M active params, "
+          f"{mcfg.n_experts} experts top-{mcfg.top_k}")
+    data = SyntheticLM(vocab_size=mcfg.vocab_size,
+                       seq_len=cfg.model.seq_len + 1,
+                       batch_size=cfg.run.batch_size)
+    ad = tad.AutoDistribute(
+        MoE(cfg.model.size, vocab_size=cfg.model.vocab_size,
+            max_seq_len=cfg.model.seq_len),
+        optimizer=optax.adamw(cfg.run.lr),
+        loss_fn=moe_next_token_loss,
+        strategy=cfg.parallel.strategy,
+    )
+    state = ad.init(jax.random.key(0), data.batch(0))
+    print(f"plan: {ad.plan.strategy} mesh={tad.mesh_degrees(ad.plan.mesh)}")
+
+    logger = MetricsLogger(
+        cfg.run.metrics_path or None, items_name="tokens",
+        console_every=cfg.run.log_every,
+    )
+    tokens_per_step = cfg.run.batch_size * cfg.model.seq_len
+    for i in range(cfg.run.steps):
+        logger.start_step()
+        state, m = ad.step(state, data.batch(i))
+        logger.log_step(i + 1, m, tokens_per_step)
+    logger.close()
+
+
+if __name__ == "__main__":
+    main()

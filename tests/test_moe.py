@@ -1,0 +1,241 @@
+"""MoE / expert-parallel tests (SURVEY.md §2.2 EP row).
+
+Tiers: routing invariants (pure), dense-vs-shard_map EP parity on the
+8-device CPU sim, planner spec assignment, and an end-to-end
+AutoDistribute training-step parity check 1-device vs EP mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import torch_automatic_distributed_neural_network_tpu as tad
+from torch_automatic_distributed_neural_network_tpu.data.synthetic import (
+    SyntheticLM,
+)
+from torch_automatic_distributed_neural_network_tpu.models import (
+    MoE,
+    moe_config,
+)
+from torch_automatic_distributed_neural_network_tpu.parallel.expert import (
+    expert_capacity,
+    moe_ffn,
+    moe_ffn_sharded,
+    top_k_routing,
+)
+from torch_automatic_distributed_neural_network_tpu.planner import (
+    detect_expert_count,
+    make_plan,
+    path_str,
+    _flatten_with_paths,
+)
+from torch_automatic_distributed_neural_network_tpu.training import (
+    moe_next_token_loss,
+)
+
+
+def _logits(b=2, s=32, e=4, seed=0):
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(rng.randn(b, s, e).astype(np.float32))
+
+
+# ---------------------------------------------------------------------------
+# routing
+# ---------------------------------------------------------------------------
+
+
+def test_routing_capacity_respected():
+    logits = _logits()
+    cap = 8
+    combine, dispatch, metrics = top_k_routing(logits, top_k=2, capacity=cap)
+    # each (expert, slot) pair holds at most one token
+    per_slot = np.asarray(dispatch).sum(axis=1)  # [B, E, C]
+    assert per_slot.max() <= 1.0 + 1e-6
+    # every token goes to at most top_k slots
+    per_token = np.asarray(dispatch).sum(axis=(2, 3))
+    assert per_token.max() <= 2 + 1e-6
+    assert np.isfinite(float(metrics["aux_loss"]))
+    assert np.isfinite(float(metrics["z_loss"]))
+
+
+def test_routing_combine_weights_normalized():
+    combine, dispatch, _ = top_k_routing(_logits(), top_k=2, capacity=32)
+    # ample capacity -> nothing dropped, renormalized gates sum to 1
+    totals = np.asarray(combine).sum(axis=(2, 3))
+    np.testing.assert_allclose(totals, 1.0, atol=1e-5)
+
+
+def test_routing_drops_overflow():
+    # all tokens prefer expert 0 -> capacity caps dispatch
+    logits = jnp.zeros((1, 64, 4)).at[..., 0].set(10.0)
+    _, dispatch, metrics = top_k_routing(logits, top_k=1, capacity=8)
+    assert float(np.asarray(dispatch)[0, :, 0].sum()) == 8.0
+    assert float(metrics["dropped_fraction"]) > 0.5
+
+
+def test_expert_capacity_multiple_of_8():
+    assert expert_capacity(128, 8, 2, 1.25) % 8 == 0
+    assert expert_capacity(4, 64, 1, 1.0) == 8  # floor
+
+
+# ---------------------------------------------------------------------------
+# dense (GSPMD) vs explicit shard_map EP parity
+# ---------------------------------------------------------------------------
+
+
+def test_moe_ffn_sharded_matches_dense(devices8):
+    E, d, f = 4, 16, 32
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(8, 32, d).astype(np.float32))
+    logits = jnp.asarray(rng.randn(8, 32, E).astype(np.float32))
+    w_up = jnp.asarray(rng.randn(E, d, f).astype(np.float32) * 0.1)
+    w_down = jnp.asarray(rng.randn(E, f, d).astype(np.float32) * 0.1)
+
+    dense_y, dense_m = moe_ffn(x, logits, w_up, w_down, top_k=2)
+
+    mesh = tad.build_mesh(data=2, expert=4)
+    shard_y, shard_m = jax.jit(
+        lambda *a: moe_ffn_sharded(*a, mesh=mesh, top_k=2)
+    )(x, logits, w_up, w_down)
+
+    np.testing.assert_allclose(
+        np.asarray(shard_y), np.asarray(dense_y), rtol=1e-5, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        float(shard_m["aux_loss"]), float(dense_m["aux_loss"]), rtol=1e-5
+    )
+
+
+def test_moe_ffn_gspmd_under_expert_mesh(devices8):
+    """Dense einsum formulation jitted over an expert mesh: GSPMD path."""
+    E, d, f = 8, 16, 32
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(4, 16, d).astype(np.float32))
+    logits = jnp.asarray(rng.randn(4, 16, E).astype(np.float32))
+    w_up = jnp.asarray(rng.randn(E, d, f).astype(np.float32) * 0.1)
+    w_down = jnp.asarray(rng.randn(E, f, d).astype(np.float32) * 0.1)
+
+    want, _ = moe_ffn(x, logits, w_up, w_down, top_k=2)
+
+    mesh = tad.build_mesh(expert=8)
+    sh = lambda spec: jax.sharding.NamedSharding(mesh, spec)
+    got, _ = jax.jit(
+        lambda *a: moe_ffn(*a, top_k=2, mesh=mesh),
+        in_shardings=(sh(P()), sh(P()), sh(P("expert")), sh(P("expert"))),
+    )(x, logits, w_up, w_down)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+    )
+
+
+# ---------------------------------------------------------------------------
+# planner
+# ---------------------------------------------------------------------------
+
+
+def _abstract_moe_params(size="test", seq=32):
+    model = MoE(size, max_seq_len=seq, vocab_size=256)
+    tokens = jnp.zeros((2, seq), jnp.int32)
+    vars_ = jax.eval_shape(model.init, jax.random.key(0), tokens)
+    return vars_["params"]
+
+
+def test_detect_expert_count():
+    params = _abstract_moe_params()  # test preset: 4 experts
+    assert detect_expert_count(params) == 4
+    from torch_automatic_distributed_neural_network_tpu.models import GPT2
+
+    gpt_vars = jax.eval_shape(
+        GPT2("test", vocab_size=256, max_seq_len=32).init,
+        jax.random.key(0), jnp.zeros((2, 32), jnp.int32),
+    )
+    assert detect_expert_count(gpt_vars["params"]) is None
+
+
+def test_ep_plan_shards_expert_banks(devices8):
+    params = _abstract_moe_params()
+    plan = make_plan(params, strategy="ep")
+    assert plan.strategy == "ep"
+    degrees = tad.mesh_degrees(plan.mesh)
+    assert degrees["expert"] == 4 and degrees["data"] == 2
+    flat = dict(_flatten_with_paths(plan.param_specs))
+    expert_specs = {p: s for p, s in flat.items() if "experts_" in p}
+    assert expert_specs, "no expert bank specs found"
+    for p, s in expert_specs.items():
+        assert "expert" in tuple(ax for dim in s for ax in (
+            dim if isinstance(dim, tuple) else (dim,)) if ax), (p, s)
+    router_specs = [s for p, s in flat.items() if "router" in p]
+    assert all(s == P() for s in router_specs)
+    # batch rides data x expert
+    assert plan.batch_spec == P(("data", "expert"))
+
+
+def test_ep_fsdp_plan(devices8):
+    params = _abstract_moe_params()
+    plan = make_plan(params, strategy="ep_fsdp")
+    degrees = tad.mesh_degrees(plan.mesh)
+    assert degrees["expert"] == 4 and degrees["fsdp"] == 2
+    assert plan.remat
+
+
+def test_ep_requires_experts(devices8):
+    from torch_automatic_distributed_neural_network_tpu.models import GPT2
+
+    gpt_vars = jax.eval_shape(
+        GPT2("test", vocab_size=256, max_seq_len=32).init,
+        jax.random.key(0), jnp.zeros((2, 32), jnp.int32),
+    )
+    with pytest.raises(ValueError, match="expert"):
+        make_plan(gpt_vars["params"], strategy="ep")
+
+
+# ---------------------------------------------------------------------------
+# end-to-end AutoDistribute
+# ---------------------------------------------------------------------------
+
+
+def _train(strategy, n_steps=3, devices=None, **ad_kwargs):
+    data = SyntheticLM(vocab_size=256, seq_len=33, batch_size=8)
+    ad = tad.AutoDistribute(
+        MoE("test", vocab_size=256, max_seq_len=32),
+        optimizer=optax.adamw(1e-3),
+        loss_fn=moe_next_token_loss,
+        strategy=strategy,
+        devices=devices,
+        **ad_kwargs,
+    )
+    state = ad.init(jax.random.key(0), data.batch(0))
+    losses = []
+    for i in range(n_steps):
+        state, m = ad.step(state, data.batch(i))
+        losses.append(float(m["loss"]))
+    return ad, losses
+
+
+def test_moe_trains_single_device():
+    ad, losses = _train("dp", devices=jax.devices()[:1])
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_moe_ep_matches_single_device(devices8):
+    _, single = _train("dp", devices=jax.devices()[:1])
+    ad, ep = _train("ep")
+    assert ad.plan.strategy == "ep"
+    assert tad.mesh_degrees(ad.plan.mesh)["expert"] == 4
+    np.testing.assert_allclose(ep, single, rtol=2e-4, atol=2e-4)
+
+
+def test_moe_auto_picks_ep(devices8):
+    ad, losses = _train("auto")
+    assert ad.plan.strategy in ("ep", "ep_fsdp")
+    assert all(np.isfinite(losses))
+
+
+def test_moe_ep_fsdp_trains(devices8):
+    ad, losses = _train("ep_fsdp")
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]

@@ -3,6 +3,7 @@
 from .gpt2 import GPT2, gpt2_config
 from .llama import Llama, llama_config
 from .mlp import MLP
+from .moe import MoE, MoEConfig, MoELM, moe_config
 from .resnet import ResNet, ResNet18Thin, ResNet50, ResNetConfig
 from .transformer_core import DecoderLM, TransformerConfig
 from .transformer_mt import Seq2SeqTransformer, TransformerMT
@@ -13,6 +14,10 @@ __all__ = [
     "gpt2_config",
     "Llama",
     "llama_config",
+    "MoE",
+    "MoEConfig",
+    "MoELM",
+    "moe_config",
     "ResNet",
     "ResNet50",
     "ResNet18Thin",

@@ -1,0 +1,143 @@
+"""Mixture-of-Experts decoder LM family (SURVEY.md §2.2 EP row).
+
+The reference's model zoo is dense (BASELINE.json:7-11); MoE + expert
+parallelism is brief-mandated.  Mixtral-style architecture on the shared
+decoder core: RMSNorm + RoPE attention, every MLP replaced by a top-k
+routed expert bank (parallel/expert.py).  The router aux losses are
+accumulated functionally through the ``nn.scan`` carry — no mutable
+collections, so the layer stack stays a single compiled scan body.
+
+Expert weights are stored as [E, d, f] einsum banks named ``experts_*``;
+the planner's MOE_RULES shard the E dim over the ``expert`` mesh axis and
+GSPMD emits the dispatch/combine all_to_all pair (moe_ffn docstring).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..parallel import context as pctx
+from ..parallel.expert import moe_ffn
+from .transformer_core import (
+    DecoderLayer,
+    TransformerConfig,
+    apply_decoder_backbone,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig(TransformerConfig):
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_coef: float = 0.01
+    router_z_coef: float = 1e-3
+
+    def num_params(self) -> int:
+        dense = super().num_params()
+        d, f, L = self.d_model, self.ff_dim, self.n_layers
+        per_layer_dense_mlp = (3 if self.act == "swiglu" else 2) * d * f
+        moe_mlp = self.n_experts * per_layer_dense_mlp + d * self.n_experts
+        return dense + L * (moe_mlp - per_layer_dense_mlp)
+
+    def active_params(self) -> int:
+        """Params touched per token (top-k of E experts) — the MFU basis."""
+        d, f, L = self.d_model, self.ff_dim, self.n_layers
+        per_expert = (3 if self.act == "swiglu" else 2) * d * f
+        return (self.num_params()
+                - L * self.n_experts * per_expert
+                + L * self.top_k * per_expert)
+
+
+class MoEMlp(nn.Module):
+    """Top-k routed expert bank replacing the dense MLP block."""
+
+    cfg: MoEConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        E, d, f = cfg.n_experts, cfg.d_model, cfg.ff_dim
+        router = nn.Dense(E, dtype=jnp.float32, use_bias=False,
+                          name="router")
+        init = nn.initializers.lecun_normal(batch_axis=(0,))
+        w_up = self.param("experts_up", init, (E, d, f), jnp.float32)
+        w_down = self.param("experts_down", init, (E, f, d), jnp.float32)
+        w_gate = (
+            self.param("experts_gate", init, (E, d, f), jnp.float32)
+            if cfg.act == "swiglu" else None
+        )
+        ctx = pctx.current()
+        cast = lambda w: None if w is None else w.astype(cfg.dtype)
+        y, metrics = moe_ffn(
+            x,
+            router(x.astype(jnp.float32)),
+            cast(w_up),
+            cast(w_down),
+            w_gate=cast(w_gate),
+            top_k=cfg.top_k,
+            capacity_factor=cfg.capacity_factor,
+            act=nn.silu if cfg.act == "swiglu" else nn.gelu,
+            mesh=ctx.mesh if ctx is not None else None,
+            batch_axes=ctx.batch_axes if ctx is not None else ("data", "fsdp"),
+        )
+        aux = (cfg.aux_loss_coef * metrics["aux_loss"]
+               + cfg.router_z_coef * metrics["z_loss"])
+        return y, aux
+
+
+class MoEDecoderLayer(DecoderLayer):
+    """DecoderLayer with the dense MLP swapped for the routed expert bank;
+    returns ``(x, aux)`` via DecoderLayer's tuple-propagating MLP slot."""
+
+    mlp_cls: type[nn.Module] = MoEMlp
+
+
+class MoELM(nn.Module):
+    """Causal MoE language model on the shared decoder backbone.
+
+    ``__call__`` returns ``(logits, aux_loss)`` — the summed router
+    load-balance + z losses; pair with
+    ``training.losses.moe_next_token_loss``.
+    """
+
+    cfg: MoEConfig
+
+    @nn.compact
+    def __call__(self, tokens, positions=None, mask=None):
+        return apply_decoder_backbone(
+            self, self.cfg, tokens, positions, mask, MoEDecoderLayer
+        )
+
+
+def moe_config(size: str = "test", **overrides) -> MoEConfig:
+    presets = {
+        # name: (n_layers, d_model, n_heads, n_experts, top_k)
+        "test": (2, 128, 4, 4, 2),
+        "nano": (4, 256, 8, 8, 2),
+        "small": (12, 768, 12, 8, 2),       # ~0.9B total, 124M-class active
+        "mixtral_tiny": (8, 512, 8, 8, 2),
+    }
+    L, d, h, E, k = presets[size]
+    base = dict(
+        vocab_size=32000,
+        d_model=d,
+        n_layers=L,
+        n_heads=h,
+        max_seq_len=1024,
+        norm="rmsnorm",
+        act="swiglu",
+        pos="rope",
+        tie_embeddings=True,
+        n_experts=E,
+        top_k=k,
+    )
+    base.update(overrides)
+    return MoEConfig(**base)
+
+
+def MoE(size: str = "test", **overrides) -> MoELM:
+    return MoELM(moe_config(size, **overrides))

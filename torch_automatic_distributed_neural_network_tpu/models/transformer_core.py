@@ -142,7 +142,13 @@ class MLPBlock(nn.Module):
 
 
 class DecoderLayer(nn.Module):
+    """Pre-norm attention + MLP block.  ``mlp_cls`` swaps the feed-forward
+    (MLPBlock dense; models/moe.py MoEMlp routed): an MLP returning
+    ``(h, aux)`` makes the layer return ``(x, aux)`` for the backbone's
+    aux-carry."""
+
     cfg: TransformerConfig
+    mlp_cls: type[nn.Module] = MLPBlock
 
     @nn.compact
     def __call__(self, x, positions, mask=None):
@@ -153,10 +159,90 @@ class DecoderLayer(nn.Module):
             h = nn.Dropout(cfg.dropout_rate, deterministic=not self.has_rng("dropout"))(h)
         x = x + h
         h = make_norm(cfg, "mlp_norm")(x)
-        h = MLPBlock(cfg, name="mlp")(h)
+        h = self.mlp_cls(cfg, name="mlp")(h)
+        aux = None
+        if isinstance(h, tuple):
+            h, aux = h
         if cfg.dropout_rate:
             h = nn.Dropout(cfg.dropout_rate, deterministic=not self.has_rng("dropout"))(h)
-        return x + h
+        return x + h if aux is None else (x + h, aux)
+
+
+def apply_decoder_backbone(
+    self: nn.Module,
+    cfg: TransformerConfig,
+    tokens,
+    positions,
+    mask,
+    layer_base: type[nn.Module],
+):
+    """Shared decoder body: embed -> (remat'd, scanned) layer stack -> norm
+    -> tied/untied head.
+
+    Called from a ``@nn.compact`` ``__call__`` of the owning module so the
+    parameter tree ("embed", "pos_embed", "layers", "final_norm",
+    "lm_head") is identical for every family.  ``layer_base`` may return
+    either ``x`` (dense layers) or ``(x, aux)`` (MoE layers — aux router
+    losses); the scan carry threads the aux sum functionally either way.
+    Returns ``(logits, aux_total)``.
+    """
+    if positions is None:
+        positions = jnp.arange(tokens.shape[1])[None, :]
+        positions = jnp.broadcast_to(positions, tokens.shape)
+    embed = nn.Embed(
+        cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+        embedding_init=nn.initializers.normal(0.02), name="embed",
+    )
+    x = embed(tokens)
+    if cfg.pos == "learned":
+        pos_emb = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (cfg.max_seq_len, cfg.d_model), jnp.float32,
+        )
+        x = x + pos_emb[None, : tokens.shape[1]].astype(cfg.dtype)
+
+    layer_cls = layer_base
+    if cfg.remat:
+        layer_cls = nn.remat(
+            layer_base,
+            policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+            prevent_cse=not cfg.scan_layers,
+        )
+
+    def run_layer(mdl, x, aux_acc):
+        out = mdl(x, positions, mask)
+        if isinstance(out, tuple):
+            x, aux = out
+            return x, aux_acc + aux
+        return out, aux_acc
+
+    aux_total = jnp.zeros((), jnp.float32)
+    if cfg.scan_layers:
+        def body(mdl, carry, _):
+            return run_layer(mdl, *carry), None
+
+        (x, aux_total), _ = nn.scan(
+            body,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            length=cfg.n_layers,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )(layer_cls(cfg, name="layers"), (x, aux_total), None)
+    else:
+        for i in range(cfg.n_layers):
+            x, aux_total = run_layer(
+                layer_cls(cfg, name=f"layers_{i}"), x, aux_total
+            )
+
+    x = make_norm(cfg, "final_norm")(x)
+    if cfg.tie_embeddings:
+        logits = embed.attend(x.astype(jnp.float32))
+    else:
+        logits = nn.Dense(
+            cfg.vocab_size, dtype=jnp.float32, use_bias=False,
+            name="lm_head",
+        )(x)
+    return logits.astype(jnp.float32), aux_total
 
 
 class DecoderLM(nn.Module):
@@ -166,47 +252,7 @@ class DecoderLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, positions=None, mask=None):
-        cfg = self.cfg
-        if positions is None:
-            positions = jnp.arange(tokens.shape[1])[None, :]
-            positions = jnp.broadcast_to(positions, tokens.shape)
-        embed = nn.Embed(
-            cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
-            embedding_init=nn.initializers.normal(0.02), name="embed",
+        logits, _ = apply_decoder_backbone(
+            self, self.cfg, tokens, positions, mask, DecoderLayer
         )
-        x = embed(tokens)
-        if cfg.pos == "learned":
-            pos_emb = self.param(
-                "pos_embed", nn.initializers.normal(0.02),
-                (cfg.max_seq_len, cfg.d_model), jnp.float32,
-            )
-            x = x + pos_emb[None, : tokens.shape[1]].astype(cfg.dtype)
-
-        layer_cls = DecoderLayer
-        if cfg.remat:
-            layer_cls = nn.remat(
-                DecoderLayer,
-                policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
-                prevent_cse=not cfg.scan_layers,
-            )
-        if cfg.scan_layers:
-            x, _ = nn.scan(
-                lambda mdl, carry, _: (mdl(carry, positions, mask), None),
-                variable_axes={"params": 0},
-                split_rngs={"params": True, "dropout": True},
-                length=cfg.n_layers,
-                metadata_params={nn.PARTITION_NAME: "layers"},
-            )(layer_cls(cfg, name="layers"), x, None)
-        else:
-            for i in range(cfg.n_layers):
-                x = layer_cls(cfg, name=f"layers_{i}")(x, positions, mask)
-
-        x = make_norm(cfg, "final_norm")(x)
-        if cfg.tie_embeddings:
-            logits = embed.attend(x.astype(jnp.float32))
-        else:
-            logits = nn.Dense(
-                cfg.vocab_size, dtype=jnp.float32, use_bias=False,
-                name="lm_head",
-            )(x)
-        return logits.astype(jnp.float32)
+        return logits

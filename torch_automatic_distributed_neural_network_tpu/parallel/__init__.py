@@ -1,5 +1,5 @@
 """Parallel execution strategies (SURVEY.md §2.2) and the comm backend."""
 
-from . import collectives, context, pipeline, ring, ulysses
+from . import collectives, context, expert, pipeline, ring, ulysses
 
-__all__ = ["collectives", "context", "pipeline", "ring", "ulysses"]
+__all__ = ["collectives", "context", "expert", "pipeline", "ring", "ulysses"]

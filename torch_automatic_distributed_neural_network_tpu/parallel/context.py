@@ -21,7 +21,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 @dataclasses.dataclass(frozen=True)
 class ParallelContext:
     mesh: Mesh
-    batch_axes: tuple[str, ...] = ("data", "fsdp")
+    batch_axes: tuple[str, ...] = ("data", "fsdp", "expert")
     seq_axis: str = "seq"
     head_axis: str = "tensor"
     seq_impl: str = "auto"  # 'auto' | 'ring' | 'ulysses'

@@ -1,0 +1,240 @@
+"""Expert parallelism (EP) for Mixture-of-Experts layers (SURVEY.md §2.2).
+
+The reference's exercised configs are dense (BASELINE.json:7-11); EP is
+brief-mandated.  TPU-native design, GShard-style (static shapes only):
+
+- **Routing** is capacity-based top-k: every (batch-row) group dispatches
+  at most ``capacity`` tokens to each expert, overflow tokens are dropped
+  (their residual path carries them).  All shapes are static — no sort /
+  no ragged gather, so the whole layer stays jit/scan/MXU friendly.
+- **Dispatch/combine are einsums** against one-hot masks.  Under GSPMD the
+  planner shards the expert dim of the expert weights and the dispatched
+  activations on the ``expert`` mesh axis; XLA then inserts the
+  all_to_all pair automatically (the NCCL-alltoall analog rides ICI).
+- ``moe_ffn_sharded`` is the explicit-collective twin (shard_map +
+  ``lax.all_to_all``) used to validate the GSPMD path and for meshes where
+  manual placement wins; it matches ``moe_ffn`` bit-for-bit on CPU sim.
+
+Terminology: E experts, C capacity slots per group, B groups (batch
+rows), S tokens per group, d model width, f expert hidden width.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def expert_capacity(
+    tokens_per_group: int, n_experts: int, top_k: int,
+    capacity_factor: float,
+) -> int:
+    """Slots each expert reserves per group; multiple of 8 for TPU lanes."""
+    c = int(tokens_per_group * top_k * capacity_factor / n_experts)
+    return max(8, -(-c // 8) * 8)
+
+
+def top_k_routing(
+    router_logits: jax.Array,  # [B, S, E] (any float dtype; softmax in fp32)
+    top_k: int,
+    capacity: int,
+    *,
+    renormalize: bool = True,
+) -> tuple[jax.Array, jax.Array, dict]:
+    """Capacity-based top-k token->expert assignment.
+
+    Returns ``(combine, dispatch, metrics)`` with
+    ``combine: [B, S, E, C]`` float gate weights (0 where dropped),
+    ``dispatch: [B, S, E, C]`` the 0/1 routing mask, and metrics holding
+    the Switch/GShard load-balance ``aux_loss``, router ``z_loss`` and the
+    dropped-token fraction.  The k choices claim capacity in choice-major
+    order (all 1st choices first), matching the reference MoE stacks.
+    """
+    if router_logits.ndim != 3:
+        raise ValueError(f"router_logits must be [B,S,E], got {router_logits.shape}")
+    B, S, E = router_logits.shape
+    logits = router_logits.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    remaining = probs
+    counts = jnp.zeros((B, 1, E), jnp.float32)  # claimed slots per expert
+    gates, masks, first_choice = [], [], None
+    for _ in range(top_k):
+        onehot = jax.nn.one_hot(jnp.argmax(remaining, -1), E,
+                                dtype=jnp.float32)  # [B,S,E]
+        if first_choice is None:
+            first_choice = onehot
+        gate = (remaining * onehot).sum(-1)  # [B,S]
+        remaining = remaining * (1.0 - onehot)
+        # position of each token inside its expert's capacity buffer
+        pos = jnp.cumsum(onehot, axis=1) - onehot + counts  # [B,S,E]
+        counts = counts + onehot.sum(axis=1, keepdims=True)
+        kept = ((pos < capacity) * onehot).sum(-1)  # [B,S] 1 if within capacity
+        slot = (pos * onehot).sum(-1).astype(jnp.int32)  # [B,S]
+        disp = (onehot[..., None]
+                * jax.nn.one_hot(slot, capacity, dtype=jnp.float32)[:, :, None]
+                * kept[..., None, None])  # [B,S,E,C]
+        masks.append(disp)
+        gates.append(gate * kept)
+
+    dispatch = sum(masks)
+    gate_stack = jnp.stack(gates, -1)  # [B,S,k]
+    if renormalize:
+        gate_stack = gate_stack / jnp.maximum(
+            gate_stack.sum(-1, keepdims=True), 1e-9
+        )
+    combine = sum(
+        g[..., None, None] * m for g, m in zip(
+            jnp.moveaxis(gate_stack, -1, 0), masks
+        )
+    )
+
+    # Switch-style load-balance loss on the first choice: E * sum_e f_e p_e
+    frac_dispatched = first_choice.mean(axis=1)  # [B,E]
+    mean_prob = probs.mean(axis=1)  # [B,E]
+    aux_loss = E * (frac_dispatched * mean_prob).sum(-1).mean()
+    z_loss = jnp.mean(jax.scipy.special.logsumexp(logits, axis=-1) ** 2)
+    dropped = 1.0 - dispatch.sum((-2, -1)).mean() / top_k
+    metrics = {"aux_loss": aux_loss, "z_loss": z_loss,
+               "dropped_fraction": dropped}
+    return combine, dispatch, metrics
+
+
+def _expert_mlp(h_in: jax.Array, w_up, w_gate, w_down,
+                act: Callable[[jax.Array], jax.Array]) -> jax.Array:
+    """Per-expert FFN on dispatched tokens: [..., E, C, d] -> [..., E, C, d].
+
+    Einsum keeps the E dim explicit so the planner can shard it; the
+    contraction dims land on the MXU as one batched matmul per expert.
+    """
+    h = jnp.einsum("...ecd,edf->...ecf", h_in, w_up)
+    if w_gate is not None:
+        h = act(jnp.einsum("...ecd,edf->...ecf", h_in, w_gate)) * h
+    else:
+        h = act(h)
+    return jnp.einsum("...ecf,efd->...ecd", h, w_down)
+
+
+def moe_ffn(
+    x: jax.Array,  # [B, S, d]
+    router_logits: jax.Array,  # [B, S, E]
+    w_up: jax.Array,  # [E, d, f]
+    w_down: jax.Array,  # [E, f, d]
+    *,
+    w_gate: jax.Array | None = None,  # [E, d, f] -> SwiGLU experts
+    top_k: int = 2,
+    capacity_factor: float = 1.25,
+    act: Callable[[jax.Array], jax.Array] = jax.nn.gelu,
+    mesh: Mesh | None = None,
+    expert_axis: str = "expert",
+    batch_axes: tuple[str, ...] = ("data", "fsdp"),
+) -> tuple[jax.Array, dict]:
+    """MoE feed-forward, GSPMD formulation.
+
+    Dense einsum dispatch/combine; if ``mesh`` has a nontrivial
+    ``expert_axis`` the dispatched tensor is constrained to it so XLA
+    emits the dispatch/return all_to_all pair over ICI.
+    """
+    B, S, d = x.shape
+    E = w_up.shape[0]
+    capacity = expert_capacity(S, E, top_k, capacity_factor)
+    combine, dispatch, metrics = top_k_routing(router_logits, top_k, capacity)
+
+    compute_dtype = x.dtype
+    h = jnp.einsum("bsec,bsd->becd", dispatch.astype(compute_dtype), x)
+    if mesh is not None:
+        degrees = dict(zip(mesh.axis_names, mesh.devices.shape))
+        if degrees.get(expert_axis, 1) > 1:
+            # [B, E, C, d]: batch stays on the data axes, experts move to
+            # the expert axis -> GSPMD inserts the all_to_all pair here
+            # and at the combine einsum below.
+            present = tuple(
+                a for a in batch_axes
+                if a != expert_axis and degrees.get(a, 1) > 1
+            )
+            h = jax.lax.with_sharding_constraint(
+                h, jax.sharding.NamedSharding(
+                    mesh, P(present or None, expert_axis)
+                )
+            )
+    h = _expert_mlp(h, w_up, w_gate, w_down, act)
+    y = jnp.einsum("bsec,becd->bsd", combine.astype(compute_dtype), h)
+    return y.astype(x.dtype), metrics
+
+
+def moe_ffn_sharded(
+    x: jax.Array,
+    router_logits: jax.Array,
+    w_up: jax.Array,
+    w_down: jax.Array,
+    *,
+    mesh: Mesh,
+    w_gate: jax.Array | None = None,
+    top_k: int = 2,
+    capacity_factor: float = 1.25,
+    act: Callable[[jax.Array], jax.Array] = jax.nn.gelu,
+    expert_axis: str = "expert",
+    batch_axes: tuple[str, ...] = ("data",),
+) -> tuple[jax.Array, dict]:
+    """Explicit-collective EP twin of :func:`moe_ffn`.
+
+    shard_map over (batch_axes..., expert_axis): tokens live on the
+    batch x expert grid, expert weights are sharded over ``expert_axis``.
+    Each shard routes its local tokens, then one ``lax.all_to_all``
+    regroups dispatched slots by owning expert, local experts run their
+    FFN, and the inverse all_to_all returns results for the combine —
+    the manual analog of what GSPMD emits for :func:`moe_ffn`.
+    """
+    degrees = dict(zip(mesh.axis_names, mesh.devices.shape))
+    ep = degrees.get(expert_axis, 1)
+    E = w_up.shape[0]
+    if E % ep:
+        raise ValueError(f"{E} experts not divisible by ep={ep}")
+    _, S, _ = x.shape
+    capacity = expert_capacity(S, E, top_k, capacity_factor)
+
+    present_batch = tuple(a for a in batch_axes if degrees.get(a, 1) > 1)
+    tok_spec = P((*present_batch, expert_axis) if ep > 1 else present_batch or None)
+    w_spec = P(expert_axis if ep > 1 else None)
+
+    def local_fn(x_l, logits_l, w_up_l, w_gate_l, w_down_l):
+        combine, dispatch, metrics = top_k_routing(logits_l, top_k, capacity)
+        h = jnp.einsum("bsec,bsd->becd", dispatch.astype(x_l.dtype), x_l)
+        if ep > 1:
+            # [B_l, E, C, d] -> regroup by expert owner: split the E dim
+            # across the ring, concat received blocks on the group dim.
+            h = jax.lax.all_to_all(
+                h, expert_axis, split_axis=1, concat_axis=0, tiled=True
+            )  # [B_l*ep, E/ep, C, d]
+        h = _expert_mlp(h, w_up_l, w_gate_l, w_down_l, act)
+        if ep > 1:
+            h = jax.lax.all_to_all(
+                h, expert_axis, split_axis=0, concat_axis=1, tiled=True
+            )  # [B_l, E, C, d]
+        y = jnp.einsum("bsec,becd->bsd", combine.astype(x_l.dtype), h)
+        # metrics are per-shard means over identical group sizes
+        metrics = jax.tree.map(
+            lambda m: jax.lax.pmean(
+                m, (*present_batch, expert_axis) if ep > 1 else present_batch
+            ) if present_batch or ep > 1 else m,
+            metrics,
+        )
+        return y.astype(x_l.dtype), metrics
+
+    gate_args = (w_gate,) if w_gate is not None else ()
+    gate_specs = (w_spec,) if w_gate is not None else ()
+
+    def fn(x_, logits_, up_, down_, *gate_):
+        return local_fn(x_, logits_, up_, gate_[0] if gate_ else None, down_)
+
+    y, metrics = shard_map(
+        fn, mesh=mesh,
+        in_specs=(tok_spec, tok_spec, w_spec, w_spec, *gate_specs),
+        out_specs=(tok_spec, P()),
+        check_vma=False,
+    )(x, router_logits, w_up, w_down, *gate_args)
+    return y, metrics

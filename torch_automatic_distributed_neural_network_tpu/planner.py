@@ -77,6 +77,14 @@ TRANSFORMER_RULES: tuple[Rule, ...] = (
     Rule(r"(norm|ln|layernorm|rmsnorm|scale)", ()),
 )
 
+# MoE expert banks (models/moe.py): [.., E, d, f] einsum weights — the E
+# dim (third-from-last, stable under nn.scan layer stacking) shards over
+# the ``expert`` mesh axis (SURVEY.md §2.2 EP row); routers replicate.
+MOE_RULES: tuple[Rule, ...] = (
+    Rule(r"(experts?_(up|gate|down)|expert_bank|moe_w\d)[^/]*$", ("expert", None, None)),
+    Rule(r"router/", ()),
+)
+
 
 # ---------------------------------------------------------------------------
 # Plan
@@ -242,7 +250,11 @@ def param_spec_tree(
     """
     degrees = topo_mod.mesh_degrees(mesh)
     use_tp = strategy in ("tp", "tp_fsdp") and degrees.get("tensor", 1) > 1
-    use_fsdp = strategy in ("fsdp", "tp_fsdp") and _axis_size(fsdp_axes, degrees) > 1
+    use_fsdp = (
+        strategy in ("fsdp", "tp_fsdp", "ep_fsdp")
+        and _axis_size(fsdp_axes, degrees) > 1
+    )
+    use_ep = degrees.get("expert", 1) > 1
     pipe = degrees.get("pipe", 1)
 
     def assign(keypath, leaf):
@@ -257,7 +269,12 @@ def param_spec_tree(
         ):
             # leading layer-stack dim -> pipeline stages (parallel/pipeline.py)
             spec = P("pipe")
-        elif use_tp:
+        if spec is None and use_ep:
+            for rule in MOE_RULES:
+                if rule.matches(path):
+                    spec = _spec_from_rule(rule, shape, degrees)
+                    break
+        if spec is None and use_tp:
             for rule in rules:
                 if rule.matches(path):
                     spec = _spec_from_rule(rule, shape, degrees)
@@ -270,9 +287,14 @@ def param_spec_tree(
 
 
 def batch_partition_spec(mesh: Mesh) -> P:
-    """Batch dim sharded over every data-carrying axis present in the mesh."""
+    """Batch dim sharded over every data-carrying axis present in the mesh.
+
+    The ``expert`` axis carries batch too (EP groups double as DP ranks,
+    DeepSpeed-MoE style): tokens ride the expert axis until the MoE
+    dispatch all_to_all regroups them by expert.
+    """
     degrees = topo_mod.mesh_degrees(mesh)
-    axes = tuple(a for a in ("data", "fsdp") if degrees.get(a, 1) > 1)
+    axes = tuple(a for a in ("data", "fsdp", "expert") if degrees.get(a, 1) > 1)
     return P(axes) if axes else P(None)
 
 
@@ -284,6 +306,27 @@ def tree_bytes(abstract_params: Any) -> int:
         dtype = np.dtype(getattr(leaf, "dtype", np.float32))
         total += math.prod(shape) * dtype.itemsize if shape else dtype.itemsize
     return total
+
+
+def _expert_banks(abstract_params: Any) -> list[tuple[str, Any]]:
+    """(path, leaf) of every MoE expert bank ([..., E, d, f], models/moe.py)."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(abstract_params)
+    return [
+        (path_str(keypath), leaf)
+        for keypath, leaf in flat
+        if len(tuple(getattr(leaf, "shape", ()))) >= 3
+        and re.search(MOE_RULES[0].pattern, path_str(keypath))
+    ]
+
+
+def detect_expert_count(abstract_params: Any) -> int | None:
+    """Number of experts E if the model has MoE expert banks, else None.
+
+    E is third-from-last in the bank shape, stable under the scanned
+    [n_layers, ...] stacking.
+    """
+    banks = _expert_banks(abstract_params)
+    return int(banks[0][1].shape[-3]) if banks else None
 
 
 def choose_strategy(
@@ -309,6 +352,25 @@ def choose_strategy(
         return "dp", {"data": 1}
     pbytes = tree_bytes(abstract_params)
     train_state_bytes = 4 * pbytes  # params + grads + 2 adam moments
+    e_count = detect_expert_count(abstract_params)
+    if e_count:
+        # MoE model: put the expert dim on its own axis so dispatch rides
+        # one all_to_all instead of replicating every expert everywhere.
+        e = math.gcd(n, e_count)
+        if e > 1:
+            rest = n // e
+            # per-device bytes: only the expert banks shard under 'ep';
+            # dense params stay replicated unless fsdp joins in.
+            expert_b = sum(
+                math.prod(leaf.shape)
+                * np.dtype(getattr(leaf, "dtype", np.float32)).itemsize
+                for _, leaf in _expert_banks(abstract_params)
+            )
+            dense_b = pbytes - expert_b
+            per_device = 4 * (dense_b + expert_b / e)
+            if per_device < 0.6 * _hbm_bytes(topo.device_kind):
+                return "ep", {"expert": e, "data": rest}
+            return "ep_fsdp", {"expert": e, "fsdp": rest}
     if train_state_bytes < 0.6 * _hbm_bytes(topo.device_kind):
         return "dp", {"data": n}
     paths = [p for p, _ in _flatten_with_paths(
@@ -349,10 +411,10 @@ def make_plan(
     chosen/requested strategy.  ``pipe`` > 1 adds a pipeline axis; layer
     stacks shard their leading dim onto it (parallel/pipeline.py).
     """
-    known = ("auto", "dp", "fsdp", "tp", "tp_fsdp")
+    known = ("auto", "dp", "fsdp", "tp", "tp_fsdp", "ep", "ep_fsdp")
     if strategy not in known:
         raise ValueError(f"Unknown strategy {strategy!r}; expected one of {known}")
-    if pipe > 1 and strategy in ("tp", "tp_fsdp"):
+    if pipe > 1 and strategy in ("tp", "tp_fsdp", "ep", "ep_fsdp"):
         raise ValueError(
             "pipeline parallelism composes with dp/fsdp only (v1); "
             f"strategy {strategy!r} + pipe={pipe} is not supported"
@@ -381,7 +443,7 @@ def make_plan(
                 abstract_params, dataclasses.replace(topo, num_devices=n),
                 rules,
             )
-            if pipe > 1 and resolved in ("tp", "tp_fsdp"):
+            if pipe > 1 and resolved in ("tp", "tp_fsdp", "ep", "ep_fsdp"):
                 # v1: pp composes with dp/fsdp only
                 resolved, degrees = "fsdp", {"fsdp": n}
         elif strategy == "dp":
@@ -398,6 +460,24 @@ def make_plan(
             while t > 2 and n // t < 2:
                 t //= 2
             degrees = {"fsdp": n // t, "tensor": t}
+        elif strategy in ("ep", "ep_fsdp"):
+            e_count = detect_expert_count(abstract_params)
+            if not e_count:
+                raise ValueError(
+                    "strategy 'ep' needs MoE expert banks "
+                    "(parameters matching MOE_RULES, e.g. experts_up); "
+                    "none found in this model"
+                )
+            e = math.gcd(n, e_count)
+            if e == 1 and n > 1:
+                raise ValueError(
+                    f"strategy {strategy!r}: gcd(n_devices={n}, "
+                    f"n_experts={e_count}) == 1 — no expert axis is "
+                    "possible on this device count; use fsdp/dp or change "
+                    "the device count / expert count"
+                )
+            degrees = {"expert": e,
+                       ("data" if strategy == "ep" else "fsdp"): n // e}
         else:
             raise ValueError(f"Unknown strategy {strategy!r}")
         if seq > 1:
@@ -420,7 +500,9 @@ def make_plan(
             )
         if strategy == "auto":
             d = topo_mod.mesh_degrees(mesh)
-            if d.get("tensor", 1) > 1 and d.get("fsdp", 1) > 1:
+            if d.get("expert", 1) > 1:
+                resolved = "ep_fsdp" if d.get("fsdp", 1) > 1 else "ep"
+            elif d.get("tensor", 1) > 1 and d.get("fsdp", 1) > 1:
                 resolved = "tp_fsdp"
             elif d.get("tensor", 1) > 1:
                 resolved = "tp"
@@ -448,7 +530,7 @@ def make_plan(
                 stacklevel=2,
             )
     if remat is None:
-        remat = resolved in ("fsdp", "tp_fsdp")
+        remat = resolved in ("fsdp", "tp_fsdp", "ep_fsdp")
     return ShardPlan(
         mesh=mesh,
         strategy=resolved,

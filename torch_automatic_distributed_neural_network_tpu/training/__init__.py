@@ -7,6 +7,7 @@ CheckpointManager`` still works and only then imports orbax.
 """
 
 from .losses import (
+    moe_next_token_loss,
     mse_loss,
     next_token_loss,
     seq2seq_loss,
@@ -27,6 +28,7 @@ __all__ = [
     "softmax_xent_loss",
     "softmax_xent_loss_mutable",
     "next_token_loss",
+    "moe_next_token_loss",
     "seq2seq_loss",
     "mse_loss",
     "MetricsLogger",

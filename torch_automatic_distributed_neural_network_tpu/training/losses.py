@@ -55,6 +55,23 @@ def softmax_xent_loss_mutable(params, model_state, batch, rng, apply_fn):
     return loss, {"accuracy": acc, "model_state": updates}
 
 
+def moe_next_token_loss(params, batch, rng, apply_fn):
+    """Causal LM loss for MoE models whose apply returns (logits, aux):
+    cross-entropy (padding-masked like next_token_loss) plus the router
+    load-balance/z losses (models/moe.py)."""
+    tokens = batch.get("input_ids", batch.get("tokens"))
+    logits, aux_loss = apply_fn(params, tokens[:, :-1])
+    targets = tokens[:, 1:]
+    losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    mask = batch.get("mask")
+    if mask is not None:
+        mask = mask[:, 1:]
+        xent = (losses * mask).sum() / jnp.maximum(mask.sum(), 1)
+    else:
+        xent = losses.mean()
+    return xent + aux_loss, {"xent": xent, "router_loss": aux_loss}
+
+
 def seq2seq_loss(params, batch, rng, apply_fn):
     """Teacher-forced MT loss: predict tgt[t+1] from src + tgt[<=t];
     target positions equal to 0 are treated as padding."""
