@@ -1,0 +1,141 @@
+"""Failure-detection / elastic-recovery tests (SURVEY.md §5, §4
+'fault injection = kill-and-resume harness on CPU sim')."""
+
+import time
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+import torch_automatic_distributed_neural_network_tpu as tad
+from torch_automatic_distributed_neural_network_tpu.data.synthetic import (
+    SyntheticLM,
+)
+from torch_automatic_distributed_neural_network_tpu.models import GPT2
+from torch_automatic_distributed_neural_network_tpu.training import (
+    CheckpointManager,
+    FaultInjector,
+    Heartbeat,
+    InjectedFault,
+    StepWatchdog,
+    Trainer,
+    TrainerConfig,
+    next_token_loss,
+    run_with_recovery,
+)
+
+
+def test_watchdog_fires_on_stall():
+    fired = []
+    wd = StepWatchdog(0.2, on_stall=lambda age: fired.append(age))
+    with wd:
+        wd.beat()
+        time.sleep(0.6)
+    assert wd.stalled and fired and fired[0] >= 0.2
+
+
+def test_watchdog_quiet_when_beating():
+    wd = StepWatchdog(0.5)
+    with wd:
+        for _ in range(6):
+            time.sleep(0.1)
+            wd.beat()
+    assert not wd.stalled
+
+
+def test_heartbeat_staleness(tmp_path):
+    d = str(tmp_path / "beats")
+    hb = Heartbeat(d, interval_s=0.1, host_index=0)
+    with hb:
+        hb.set_step(7)
+        time.sleep(0.25)
+        assert Heartbeat.stale_hosts(d, max_age_s=5.0) == []
+    beats = Heartbeat.read_all(d)
+    assert beats[0]["step"] == 7
+    # a host whose beat is old shows up stale
+    time.sleep(0.3)
+    assert Heartbeat.stale_hosts(d, max_age_s=0.2) == [0]
+
+
+def _make_trainer(tmp_path, steps, callbacks=None, devices=None):
+    ad = tad.AutoDistribute(
+        GPT2("test", vocab_size=256, max_seq_len=32),
+        optimizer=optax.adamw(1e-3),
+        loss_fn=next_token_loss,
+        strategy="dp",
+        devices=devices,
+    )
+    ckpt = CheckpointManager(str(tmp_path / "ckpt"), save_interval_steps=0)
+    return Trainer(
+        ad,
+        TrainerConfig(steps=steps, log_every=0, ckpt_every=2),
+        ckpt=ckpt,
+        callbacks=callbacks,
+    )
+
+
+def test_kill_and_resume_matches_uninterrupted(devices8, tmp_path):
+    data = SyntheticLM(vocab_size=256, seq_len=33, batch_size=8)
+    steps = 8
+
+    # uninterrupted oracle
+    t0 = _make_trainer(tmp_path / "a", steps)
+    final_a = t0.fit(data)
+    t0.ckpt.close()
+
+    # killed at step 5, recovered; step-indexed data keeps batches aligned
+    fault = FaultInjector(at_step=5)
+    trainer = _make_trainer(tmp_path / "b", steps, callbacks=[fault])
+    restarts = []
+    final_b = run_with_recovery(
+        lambda: trainer.fit(data),
+        max_restarts=1,
+        retriable=(InjectedFault,),
+        on_restart=lambda n, e: restarts.append((n, str(e))),
+    )
+    trainer.ckpt.close()
+
+    assert restarts, "fault did not fire"
+    assert int(final_b.step) == steps
+    np.testing.assert_allclose(
+        np.asarray(jax.tree.leaves(final_a.params)[0]),
+        np.asarray(jax.tree.leaves(final_b.params)[0]),
+        rtol=1e-6, atol=1e-6,
+    )
+
+
+def test_recovery_gives_up_after_max_restarts(devices8, tmp_path):
+    data = SyntheticLM(vocab_size=256, seq_len=33, batch_size=8)
+
+    def always_fail(step, state, metrics):
+        raise InjectedFault("persistent failure")
+
+    trainer = _make_trainer(tmp_path, 8, callbacks=[always_fail])
+    with pytest.raises(InjectedFault):
+        run_with_recovery(
+            lambda: trainer.fit(data),
+            max_restarts=2,
+            retriable=(InjectedFault,),
+            on_restart=lambda n, e: None,
+        )
+    trainer.ckpt.close()
+
+
+def test_resume_on_different_mesh(devices8, tmp_path):
+    """Elastic resume onto a different mesh shape: 8-way dp checkpoint
+    restored into a 4-device dp run (resharding restore)."""
+    data = SyntheticLM(vocab_size=256, seq_len=33, batch_size=8)
+    fault = FaultInjector(at_step=5)
+    t8 = _make_trainer(tmp_path, 8, callbacks=[fault])
+    with pytest.raises(InjectedFault):
+        t8.fit(data)
+    t8.ckpt.wait()
+
+    t4 = _make_trainer(tmp_path, 8, devices=jax.devices()[:4])
+    final = t4.fit(data)
+    t4.ckpt.close()
+    assert int(final.step) == 8
+    assert np.isfinite(
+        float(np.asarray(jax.tree.leaves(final.params)[0]).sum())
+    )

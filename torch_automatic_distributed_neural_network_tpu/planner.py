@@ -370,7 +370,15 @@ def choose_strategy(
             per_device = 4 * (dense_b + expert_b / e)
             if per_device < 0.6 * _hbm_bytes(topo.device_kind):
                 return "ep", {"expert": e, "data": rest}
-            return "ep_fsdp", {"expert": e, "fsdp": rest}
+            # Memory-tight: the fsdp axis must be real (>=2) or dense
+            # params stay replicated — shrink the expert degree to free
+            # devices for it (e must still divide gcd(n, e_count)).
+            g = e
+            while e > 1 and n // e < 2:
+                e = max(d for d in range(1, e) if g % d == 0)
+            if e > 1:
+                return "ep_fsdp", {"expert": e, "fsdp": n // e}
+            # can't keep both axes nontrivial -> fall through to fsdp/dp
     if train_state_bytes < 0.6 * _hbm_bytes(topo.device_kind):
         return "dp", {"data": n}
     paths = [p for p, _ in _flatten_with_paths(

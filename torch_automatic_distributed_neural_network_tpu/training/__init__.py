@@ -22,6 +22,11 @@ _LAZY = {
     "restore_or_init": "checkpoint",
     "Trainer": "trainer",
     "TrainerConfig": "trainer",
+    "FaultInjector": "elastic",
+    "Heartbeat": "elastic",
+    "InjectedFault": "elastic",
+    "StepWatchdog": "elastic",
+    "run_with_recovery": "elastic",
 }
 
 __all__ = [

@@ -1,0 +1,218 @@
+"""Failure detection + elastic recovery (SURVEY.md §5).
+
+The reference world detects failures through NCCL timeouts and torchrun's
+worker supervision; recovery is manual.  In the single-controller TPU
+model the analogous subsystem is:
+
+- **Heartbeat**: each host writes a small JSON beat (host, step, time) to
+  a shared directory; any host — or an external supervisor — can detect a
+  stale peer.  TPU slices fail whole, so this is the multi-host liveness
+  signal, not a per-GPU one.
+- **StepWatchdog**: in-process stall detector — if no training step
+  completes within ``timeout_s`` (hung collective, wedged runtime), the
+  watchdog fires a callback (default: loud stderr report) so the run can
+  be killed and resumed instead of hanging silently.
+- **run_with_recovery**: the recovery primitive.  Re-invokes the training
+  function after a failure; the Trainer's checkpoint-restore path
+  (checkpoint.restore_or_init) brings the run back to the last saved
+  step, including onto a *different* mesh shape (resharding restore).
+- **FaultInjector**: deterministic fault injection for kill-and-resume
+  tests (SURVEY.md §4: fault injection = kill-and-resume harness on CPU
+  sim).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Callable
+
+import jax
+
+
+class InjectedFault(RuntimeError):
+    """Raised by FaultInjector; distinguishable from real failures."""
+
+
+@dataclasses.dataclass
+class FaultInjector:
+    """Train-loop callback that kills the run at a chosen step, once.
+
+    Use as a Trainer callback: ``Trainer(..., callbacks=[FaultInjector(5)])``.
+    """
+
+    at_step: int
+    exc: type[BaseException] = InjectedFault
+    fired: bool = False
+
+    def __call__(self, step: int, state: Any, metrics: dict) -> None:
+        if not self.fired and step == self.at_step:
+            self.fired = True
+            raise self.exc(f"injected fault at step {step}")
+
+
+class Heartbeat:
+    """Periodic liveness beat to ``directory/host_<idx>.json``.
+
+    The directory is expected to be shared across hosts (GCS fuse / NFS)
+    in multi-host runs; ``stale_hosts`` reads every peer's beat and
+    returns those older than ``max_age_s``.
+    """
+
+    def __init__(self, directory: str, *, interval_s: float = 10.0,
+                 host_index: int | None = None):
+        self.directory = directory
+        self.interval_s = interval_s
+        self.host_index = (jax.process_index() if host_index is None
+                           else host_index)
+        self._step = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        os.makedirs(directory, exist_ok=True)
+
+    @property
+    def path(self) -> str:
+        return os.path.join(self.directory, f"host_{self.host_index}.json")
+
+    def set_step(self, step: int) -> None:
+        self._step = step
+
+    def _write(self) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"host": self.host_index, "step": self._step,
+                       "time": time.time()}, f)
+        os.replace(tmp, self.path)
+
+    def start(self) -> "Heartbeat":
+        self._write()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self._write()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval_s + 1)
+        self._write()  # final beat records the last step
+
+    def __enter__(self) -> "Heartbeat":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    @staticmethod
+    def read_all(directory: str) -> dict[int, dict]:
+        beats: dict[int, dict] = {}
+        if not os.path.isdir(directory):
+            return beats
+        for name in os.listdir(directory):
+            if name.startswith("host_") and name.endswith(".json"):
+                try:
+                    with open(os.path.join(directory, name)) as f:
+                        b = json.load(f)
+                    beats[int(b["host"])] = b
+                except (ValueError, KeyError, OSError):
+                    continue  # torn write — next beat will fix it
+        return beats
+
+    @staticmethod
+    def stale_hosts(directory: str, *, max_age_s: float) -> list[int]:
+        now = time.time()
+        return sorted(
+            h for h, b in Heartbeat.read_all(directory).items()
+            if now - b["time"] > max_age_s
+        )
+
+
+class StepWatchdog:
+    """Fires ``on_stall`` if no ``beat()`` arrives within ``timeout_s``.
+
+    Catches hung collectives / wedged device runtimes, which otherwise
+    block the single controller forever with no error.  Default action
+    reports loudly to stderr; pass ``on_stall`` to escalate (e.g.
+    ``os._exit`` so a supervisor restarts the job).
+    """
+
+    def __init__(self, timeout_s: float,
+                 on_stall: Callable[[float], None] | None = None):
+        self.timeout_s = timeout_s
+        self.on_stall = on_stall or self._default_stall
+        self.stalled = False
+        self._last = time.monotonic()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def _default_stall(self, age_s: float) -> None:
+        print(
+            f"[tadnn watchdog] no step completed for {age_s:.1f}s "
+            f"(timeout {self.timeout_s}s) — training appears stalled",
+            file=sys.stderr, flush=True,
+        )
+
+    def beat(self) -> None:
+        self._last = time.monotonic()
+
+    def start(self) -> "StepWatchdog":
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        poll = min(1.0, self.timeout_s / 4)
+        while not self._stop.wait(poll):
+            age = time.monotonic() - self._last
+            if age > self.timeout_s:
+                self.stalled = True
+                self.on_stall(age)
+                self._last = time.monotonic()  # report once per timeout
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def __enter__(self) -> "StepWatchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def run_with_recovery(
+    fit: Callable[[], Any],
+    *,
+    max_restarts: int = 2,
+    retriable: tuple[type[BaseException], ...] = (Exception,),
+    on_restart: Callable[[int, BaseException], None] | None = None,
+) -> Any:
+    """Invoke ``fit`` and restart it after retriable failures.
+
+    ``fit`` must be resumable — e.g. a closure over ``Trainer.fit`` with a
+    CheckpointManager, which restores the latest checkpoint on re-entry
+    (restore_or_init).  Elastic resume onto a different mesh works because
+    restore takes the *target* shardings (checkpoint.py docstring).
+    """
+    attempt = 0
+    while True:
+        try:
+            return fit()
+        except retriable as e:
+            attempt += 1
+            if attempt > max_restarts:
+                raise
+            if on_restart is not None:
+                on_restart(attempt, e)
+            elif jax.process_index() == 0:
+                print(f"[tadnn elastic] restart {attempt}/{max_restarts} "
+                      f"after {type(e).__name__}: {e}", file=sys.stderr,
+                      flush=True)

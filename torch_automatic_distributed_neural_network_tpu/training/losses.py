@@ -22,21 +22,26 @@ def softmax_xent_loss(params, batch, rng, apply_fn):
     return loss, {"accuracy": acc}
 
 
+def _shifted_xent(logits, tokens, mask):
+    """Next-token cross-entropy on already-shifted logits; returns
+    (mean loss, token count), padding-masked when ``mask`` is given.
+    Shared by the dense and MoE LM losses so the conventions can't
+    diverge."""
+    targets = tokens[:, 1:]
+    losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    if mask is not None:
+        mask = mask[:, 1:]
+        denom = jnp.maximum(mask.sum(), 1)
+        return (losses * mask).sum() / denom, denom
+    return losses.mean(), jnp.asarray(targets.size, jnp.float32)
+
+
 def next_token_loss(params, batch, rng, apply_fn):
     """Causal LM: predict token t+1 from tokens <= t; ignores padding 0s
     if an explicit ``mask`` is present."""
     tokens = batch.get("input_ids", batch.get("tokens"))
     logits = apply_fn(params, tokens[:, :-1])
-    targets = tokens[:, 1:]
-    losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
-    mask = batch.get("mask")
-    if mask is not None:
-        mask = mask[:, 1:]
-        loss = (losses * mask).sum() / jnp.maximum(mask.sum(), 1)
-        denom = jnp.maximum(mask.sum(), 1)
-    else:
-        loss = losses.mean()
-        denom = jnp.asarray(targets.size, jnp.float32)
+    loss, denom = _shifted_xent(logits, tokens, batch.get("mask"))
     return loss, {"tokens": denom}
 
 
@@ -57,18 +62,11 @@ def softmax_xent_loss_mutable(params, model_state, batch, rng, apply_fn):
 
 def moe_next_token_loss(params, batch, rng, apply_fn):
     """Causal LM loss for MoE models whose apply returns (logits, aux):
-    cross-entropy (padding-masked like next_token_loss) plus the router
-    load-balance/z losses (models/moe.py)."""
+    next_token_loss's cross-entropy plus the router load-balance/z losses
+    (models/moe.py)."""
     tokens = batch.get("input_ids", batch.get("tokens"))
     logits, aux_loss = apply_fn(params, tokens[:, :-1])
-    targets = tokens[:, 1:]
-    losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
-    mask = batch.get("mask")
-    if mask is not None:
-        mask = mask[:, 1:]
-        xent = (losses * mask).sum() / jnp.maximum(mask.sum(), 1)
-    else:
-        xent = losses.mean()
+    xent, _ = _shifted_xent(logits, tokens, batch.get("mask"))
     return xent + aux_loss, {"xent": xent, "router_loss": aux_loss}
 
 

@@ -41,6 +41,8 @@ class TrainerConfig:
     ckpt_every: int = 0  # 0 = no checkpointing
     nan_action: str = "raise"  # 'raise' | 'warn' | 'ignore'
     divergence_every: int = 0  # 0 = off; N = check params hash every N
+    watchdog_timeout_s: float = 0.0  # 0 = off; stall detector (elastic.py)
+    heartbeat_dir: str = ""  # "" = off; shared-dir liveness beats
 
 
 class Trainer:
@@ -53,6 +55,7 @@ class Trainer:
         ckpt: CheckpointManager | None = None,
         items_per_step: int | None = None,
         run_config: dict | None = None,
+        callbacks: "list[Callable[[int, TrainState, dict], None]] | None" = None,
     ):
         self.ad = ad
         self.cfg = cfg
@@ -60,18 +63,29 @@ class Trainer:
         self.ckpt = ckpt
         self.items_per_step = items_per_step
         self.run_config = run_config
+        self.callbacks = list(callbacks or [])
 
     def fit(
         self,
-        data: Iterable[Any],
+        data: "Iterable[Any] | Any",
         *,
         rng: jax.Array | None = None,
         state: "TrainState | None" = None,
     ) -> "TrainState":
+        """Run the training loop.
+
+        ``data`` is either an iterable of batches or a step-indexed source
+        exposing ``.batch(i)``.  Prefer the latter with checkpointing: a
+        resumed run then sees exactly the batches an uninterrupted run
+        would have seen at each step (elastic parity, SURVEY.md §5); a
+        plain iterator restarts from its beginning on resume.
+        """
         cfg = self.cfg
-        data_iter = iter(data)
-        first = next(data_iter)
+        indexed = hasattr(data, "batch")
+        data_iter = None if indexed else iter(data)
+        first = None
         if state is None:
+            first = data.batch(0) if indexed else next(data_iter)
             rng = rng if rng is not None else jax.random.key(0)
             state, resumed = restore_or_init(self.ad, self.ckpt, rng, first)
             start = int(state.step)
@@ -80,28 +94,61 @@ class Trainer:
         else:
             start = int(state.step)
 
-        if self.metrics:
-            self.metrics.start_step()
-        batch = first
-        for i in range(start, cfg.steps):
-            state, step_metrics = self.ad.step(state, batch)
-            if i + 1 < cfg.steps:
-                batch = next(data_iter)
-            if cfg.log_every and (i % cfg.log_every == 0 or i == cfg.steps - 1):
-                self._guard_nan(step_metrics, i)
-                if self.metrics:
-                    self.metrics.log_step(
-                        i, step_metrics, self.items_per_step or 0
-                    )
-            if cfg.divergence_every and i % cfg.divergence_every == 0:
-                self._guard_divergence(state, i)
-            if self.ckpt and cfg.ckpt_every and (i + 1) % cfg.ckpt_every == 0:
-                self.ckpt.save(i + 1, state, config=self.run_config)
-        if self.ckpt and cfg.ckpt_every:
-            if self.ckpt.latest_step() != cfg.steps:
-                self.ckpt.save(cfg.steps, state, config=self.run_config,
-                               force=True)
-            self.ckpt.wait()
+        from .elastic import Heartbeat, StepWatchdog
+
+        watchdog = (StepWatchdog(cfg.watchdog_timeout_s).start()
+                    if cfg.watchdog_timeout_s else None)
+        heartbeat = (Heartbeat(cfg.heartbeat_dir).start()
+                     if cfg.heartbeat_dir else None)
+        try:
+            if self.metrics:
+                self.metrics.start_step()
+            if start < cfg.steps:
+                if not indexed:
+                    batch = first if first is not None else next(data_iter)
+                elif start == 0 and first is not None:
+                    batch = first
+                else:
+                    batch = data.batch(start)
+            for i in range(start, cfg.steps):
+                state, step_metrics = self.ad.step(state, batch)
+                if i + 1 < cfg.steps:
+                    batch = data.batch(i + 1) if indexed else next(data_iter)
+                if watchdog:
+                    watchdog.beat()
+                if heartbeat:
+                    heartbeat.set_step(i + 1)
+                if cfg.log_every and (
+                    i % cfg.log_every == 0 or i == cfg.steps - 1
+                ):
+                    self._guard_nan(step_metrics, i)
+                    if self.metrics:
+                        self.metrics.log_step(
+                            i, step_metrics, self.items_per_step or 0
+                        )
+                if cfg.divergence_every and i % cfg.divergence_every == 0:
+                    self._guard_divergence(state, i)
+                if (
+                    self.ckpt and cfg.ckpt_every
+                    and (i + 1) % cfg.ckpt_every == 0
+                ):
+                    self.ckpt.save(i + 1, state, config=self.run_config)
+                for cb in self.callbacks:
+                    cb(i + 1, state, step_metrics)
+            if self.ckpt and cfg.ckpt_every:
+                if self.ckpt.latest_step() != cfg.steps:
+                    self.ckpt.save(cfg.steps, state, config=self.run_config,
+                                   force=True)
+                self.ckpt.wait()
+        finally:
+            if watchdog:
+                watchdog.stop()
+            if heartbeat:
+                heartbeat.stop()
+            if self.ckpt:
+                # barrier for in-flight async saves: a recovery restart
+                # must not race the pending commit (elastic.py)
+                self.ckpt.wait()
         return state
 
     # -- guards -------------------------------------------------------------
