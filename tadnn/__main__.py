@@ -1,0 +1,3 @@
+from torch_automatic_distributed_neural_network_tpu.cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,39 @@
+"""Launcher CLI tests (component C9): the torchrun analog is one process
+per host, so the CLI is exercised in-process."""
+
+import json
+
+from torch_automatic_distributed_neural_network_tpu import cli
+
+
+def test_devices_json(capsys):
+    assert cli.main(["devices", "--json"]) == 0
+    out = capsys.readouterr().out
+    payload = json.loads(out.strip().splitlines()[-1])
+    assert payload["num_devices"] == 8
+    assert payload["process_count"] == 1
+
+
+def test_bench_allreduce(capsys):
+    assert cli.main(["bench", "--ops", "allreduce",
+                     "--sizes", str(2**20)]) == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["op"] == "allreduce"
+    assert rec["n_devices"] == 8
+    assert rec["bus_bw_gbps"] > 0
+
+
+def test_run_executes_script(tmp_path, capsys):
+    script = tmp_path / "hello.py"
+    script.write_text(
+        "import sys\nprint('script-ran', sys.argv[1])\n"
+    )
+    assert cli.main(["run", str(script), "arg1"]) == 0
+    assert "script-ran arg1" in capsys.readouterr().out
+
+
+def test_run_strips_separator(tmp_path, capsys):
+    script = tmp_path / "argcheck.py"
+    script.write_text("import sys\nprint('argv:', sys.argv[1:])\n")
+    assert cli.main(["run", str(script), "--", "--steps", "5"]) == 0
+    assert "argv: ['--steps', '5']" in capsys.readouterr().out

@@ -1,0 +1,137 @@
+"""Command-line launcher (component C9, SURVEY.md §1 L1).
+
+The reference launches one process per GPU via ``torchrun``/``mp.spawn``
+(BASELINE.json:5).  Single-controller JAX needs no per-device spawn: one
+process per *host* drives every local chip, so the launcher's job shrinks
+to multi-host initialization + convenience commands::
+
+    python -m torch_automatic_distributed_neural_network_tpu devices
+    python -m torch_automatic_distributed_neural_network_tpu run train.py [args...]
+    python -m torch_automatic_distributed_neural_network_tpu profile train.py --logdir /tmp/tb [args...]
+    python -m torch_automatic_distributed_neural_network_tpu bench [--ops allreduce,allgather] [--sizes 1048576,...]
+
+(`tadnn` works as the module name too.)  ``run`` calls
+``jax.distributed.initialize()`` first when a multi-host environment is
+detected (coordinator address in env), then executes the script in
+__main__ — the torchrun analog with no rank bookkeeping.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import runpy
+import sys
+
+
+def _maybe_init_distributed() -> None:
+    """Initialize the multi-host runtime when the env asks for it."""
+    import jax
+
+    if (
+        os.environ.get("JAX_COORDINATOR_ADDRESS")
+        or os.environ.get("COORDINATOR_ADDRESS")
+        or int(os.environ.get("TADNN_NUM_PROCESSES", "1")) > 1
+    ):
+        from . import topology
+
+        topology.initialize_distributed()
+        if jax.process_index() == 0:
+            print(
+                f"distributed: {jax.process_count()} processes, "
+                f"{jax.device_count()} devices"
+            )
+
+
+def cmd_devices(args: argparse.Namespace) -> int:
+    import jax
+
+    from . import topology
+
+    topo = topology.detect()
+    print(f"process {jax.process_index()}/{jax.process_count()}")
+    print(f"devices: {topo.num_devices} x {topo.device_kind}")
+    print(f"local devices: {len(jax.local_devices())}")
+    print(f"multihost: {topo.is_multihost}  multislice: {topo.is_multislice}")
+    if args.json:
+        print(json.dumps({
+            "num_devices": topo.num_devices,
+            "device_kind": topo.device_kind,
+            "process_count": jax.process_count(),
+        }))
+    return 0
+
+
+def _run_script(script: str, script_args: list[str]) -> int:
+    if script_args and script_args[0] == "--":
+        script_args = script_args[1:]
+    sys.argv = [script, *script_args]
+    sys.path.insert(0, os.path.dirname(os.path.abspath(script)) or ".")
+    runpy.run_path(script, run_name="__main__")
+    return 0
+
+
+def cmd_run(args: argparse.Namespace) -> int:
+    _maybe_init_distributed()
+    return _run_script(args.script, args.script_args)
+
+
+def cmd_profile(args: argparse.Namespace) -> int:
+    """Run a script under a jax.profiler trace (TensorBoard-viewable)."""
+    import jax
+
+    _maybe_init_distributed()
+    os.makedirs(args.logdir, exist_ok=True)
+    with jax.profiler.trace(args.logdir):
+        rc = _run_script(args.script, args.script_args)
+    print(f"profile trace written to {args.logdir}")
+    return rc
+
+
+def cmd_bench(args: argparse.Namespace) -> int:
+    """Collectives microbenchmark (allreduce bus-bw is a BASELINE metric)."""
+    from .parallel.collectives import bench_sweep
+
+    ops = args.ops.split(",")
+    sizes = [int(s) for s in args.sizes.split(",")]
+    for r in bench_sweep(sizes=sizes, ops=ops, axis=args.axis):
+        print(json.dumps(r.to_json()))
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tadnn",
+        description="TPU-native automatic-distribution launcher",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("devices", help="print device topology")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_devices)
+
+    p = sub.add_parser("run", help="launch a training script "
+                                   "(initializes multi-host if configured)")
+    p.add_argument("script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    p.set_defaults(fn=cmd_run)
+
+    p = sub.add_parser("profile", help="run a script under jax.profiler")
+    p.add_argument("script")
+    p.add_argument("--logdir", default="/tmp/tadnn_profile")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    p.set_defaults(fn=cmd_profile)
+
+    p = sub.add_parser("bench", help="collectives microbenchmark")
+    p.add_argument("--ops", default="allreduce,allgather,reduce_scatter")
+    p.add_argument("--sizes", default=str(64 * 2**20))
+    p.add_argument("--axis", default="data")
+    p.set_defaults(fn=cmd_bench)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

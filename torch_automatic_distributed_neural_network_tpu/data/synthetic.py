@@ -20,6 +20,8 @@ class SyntheticClassification:
     loss curves actually decrease.
     """
 
+    step_indexed = True  # Trainer protocol: .batch(i) is keyed by step
+
     def __init__(
         self,
         image_shape: tuple[int, ...] = (28, 28, 1),
@@ -52,6 +54,8 @@ class SyntheticClassification:
 class SyntheticLM:
     """Deterministic token stream (GPT-2 / Llama shaped): a noisy copy task
     (next token depends on the previous one) so LM loss is reducible."""
+
+    step_indexed = True  # Trainer protocol: .batch(i) is keyed by step
 
     def __init__(
         self,

@@ -371,11 +371,11 @@ def choose_strategy(
             if per_device < 0.6 * _hbm_bytes(topo.device_kind):
                 return "ep", {"expert": e, "data": rest}
             # Memory-tight: the fsdp axis must be real (>=2) or dense
-            # params stay replicated — shrink the expert degree to free
-            # devices for it (e must still divide gcd(n, e_count)).
-            g = e
-            while e > 1 and n // e < 2:
-                e = max(d for d in range(1, e) if g % d == 0)
+            # params stay replicated — shrink the expert degree once to
+            # free devices for it (e divides n, so one shrink to a proper
+            # divisor always leaves n // e >= 2).
+            if n // e < 2:
+                e = max(d for d in range(1, e) if e % d == 0)
             if e > 1:
                 return "ep_fsdp", {"expert": e, "fsdp": n // e}
             # can't keep both axes nontrivial -> fall through to fsdp/dp

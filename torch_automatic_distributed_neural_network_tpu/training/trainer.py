@@ -75,13 +75,18 @@ class Trainer:
         """Run the training loop.
 
         ``data`` is either an iterable of batches or a step-indexed source
-        exposing ``.batch(i)``.  Prefer the latter with checkpointing: a
-        resumed run then sees exactly the batches an uninterrupted run
-        would have seen at each step (elastic parity, SURVEY.md §5); a
-        plain iterator restarts from its beginning on resume.
+        (``step_indexed = True`` and a ``.batch(i)`` method, like the
+        data.synthetic classes — an explicit marker, because ``.batch(n)``
+        on common iterables like tf.data means a batch-size transform).
+        Prefer step-indexed with checkpointing: a resumed run then sees
+        exactly the batches an uninterrupted run would have seen at each
+        step (elastic parity, SURVEY.md §5); a plain iterator restarts
+        from its beginning on resume.
         """
         cfg = self.cfg
-        indexed = hasattr(data, "batch")
+        indexed = getattr(data, "step_indexed", False) and callable(
+            getattr(data, "batch", None)
+        )
         data_iter = None if indexed else iter(data)
         first = None
         if state is None:
@@ -96,8 +101,10 @@ class Trainer:
 
         from .elastic import Heartbeat, StepWatchdog
 
-        watchdog = (StepWatchdog(cfg.watchdog_timeout_s).start()
-                    if cfg.watchdog_timeout_s else None)
+        # The watchdog is armed after the first step completes: the first
+        # step includes jit compilation (minutes for big models), which a
+        # steady-state timeout would misreport as a stall.
+        watchdog: StepWatchdog | None = None
         heartbeat = (Heartbeat(cfg.heartbeat_dir).start()
                      if cfg.heartbeat_dir else None)
         try:
@@ -114,7 +121,12 @@ class Trainer:
                 state, step_metrics = self.ad.step(state, batch)
                 if i + 1 < cfg.steps:
                     batch = data.batch(i + 1) if indexed else next(data_iter)
-                if watchdog:
+                if cfg.watchdog_timeout_s:
+                    # beat on step *completion*, not dispatch — a hung
+                    # collective must stop the beats (elastic.py)
+                    jax.block_until_ready(step_metrics)
+                    if watchdog is None:
+                        watchdog = StepWatchdog(cfg.watchdog_timeout_s).start()
                     watchdog.beat()
                 if heartbeat:
                     heartbeat.set_step(i + 1)
