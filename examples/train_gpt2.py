@@ -41,6 +41,11 @@ class ModelCfg:
 
 
 @dataclasses.dataclass(frozen=True)
+class DataCfg:
+    path: str = ""  # TADN token file (data/loader.py); "" = synthetic
+
+
+@dataclasses.dataclass(frozen=True)
 class RunCfg:
     steps: int = 50
     batch_size: int = 8
@@ -60,6 +65,7 @@ class ParallelCfg:
 @dataclasses.dataclass(frozen=True)
 class Cfg:
     model: ModelCfg = ModelCfg()
+    data: DataCfg = DataCfg()
     run: RunCfg = RunCfg()
     parallel: ParallelCfg = ParallelCfg()
 
@@ -73,10 +79,22 @@ def main():
         cfg.model.size, vocab_size=cfg.model.vocab_size,
         max_seq_len=cfg.model.seq_len,
     )
-    data = SyntheticLM(
-        vocab_size=mcfg.vocab_size, seq_len=cfg.model.seq_len + 1,
-        batch_size=cfg.run.batch_size,
-    )
+    if cfg.data.path:
+        from torch_automatic_distributed_neural_network_tpu.data import (
+            TokenFileDataset,
+        )
+
+        data = TokenFileDataset(
+            cfg.data.path, seq_len=cfg.model.seq_len,
+            batch_size=cfg.run.batch_size,
+        )
+        print(f"data: {cfg.data.path} ({data.n_tokens:,} tokens, "
+              f"{data.backend} backend)")
+    else:
+        data = SyntheticLM(
+            vocab_size=mcfg.vocab_size, seq_len=cfg.model.seq_len + 1,
+            batch_size=cfg.run.batch_size,
+        )
     ad = tad.AutoDistribute(
         GPT2(cfg.model.size, vocab_size=cfg.model.vocab_size,
              max_seq_len=cfg.model.seq_len),
@@ -113,7 +131,7 @@ def main():
         items_per_step=tokens_per_step,
         run_config=cfglib.to_dict(cfg),
     )
-    state = trainer.fit(iter(data))
+    state = trainer.fit(data)  # step-indexed: elastic resume replays batches
     print(f"plan: {ad.plan.strategy} mesh={tad.mesh_degrees(ad.plan.mesh)} "
           f"params={mcfg.num_params()/1e6:.0f}M final_step={int(state.step)}")
 

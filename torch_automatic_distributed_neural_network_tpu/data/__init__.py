@@ -1,1 +1,13 @@
-"""Input pipelines: synthetic datasets + per-host sharded loaders (C13)."""
+"""Input pipelines: synthetic datasets, the native token-file loader and
+per-host sharded input (C13)."""
+
+from .loader import TokenFileDataset, shard_for_host, write_token_file
+from .synthetic import SyntheticClassification, SyntheticLM
+
+__all__ = [
+    "SyntheticClassification",
+    "SyntheticLM",
+    "TokenFileDataset",
+    "shard_for_host",
+    "write_token_file",
+]

@@ -1,0 +1,257 @@
+"""Token-corpus data loader (component C13) with a native C++ fast path.
+
+The reference rides torch ``DataLoader`` + ``DistributedSampler`` (C++
+worker threads under the hood, SURVEY.md C13).  The TPU-native analog:
+
+- a flat binary token-file format ("TADN" v1: header + little-endian
+  uint16/uint32 tokens) written by :func:`write_token_file`;
+- :class:`TokenFileDataset`, step-indexed (Trainer protocol) so elastic
+  resume replays identical batches — window ``w`` of epoch ``e`` maps
+  through a deterministic affine shuffle ``(a_e * w + c_e) % n_windows``
+  seeded by splitmix64;
+- a **native C++ backend** (native/tadnn_loader.cpp): mmap + background
+  prefetch thread, compiled on demand with g++ and bound via ctypes.
+  The pure-numpy fallback implements the identical determinism contract
+  (bit-for-bit — tests/test_loader.py), so the backend is a pure speed
+  choice;
+- :func:`shard_for_host` for per-host input sharding under multi-host
+  (each host loads only its rows, then
+  ``jax.make_array_from_process_local_data`` assembles the global batch).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Any
+
+import numpy as np
+
+_MAGIC = 0x4E444154  # "TADN"
+_HEADER = np.dtype([
+    ("magic", "<u4"), ("version", "<u4"), ("dtype_bytes", "<u4"),
+    ("pad", "<u4"), ("n_tokens", "<u8"),
+])
+
+_REPO_NATIVE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+
+_MASK64 = (1 << 64) - 1
+
+
+def _splitmix64(x: int) -> int:
+    x = (x + 0x9E3779B97F4A7C15) & _MASK64
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & _MASK64
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & _MASK64
+    return x ^ (x >> 31)
+
+
+def write_token_file(path: str, tokens: np.ndarray) -> None:
+    """Write a TADN v1 token file; dtype picked from the token range."""
+    tokens = np.asarray(tokens).ravel()
+    if tokens.size and tokens.min() < 0:
+        raise ValueError("tokens must be non-negative")
+    dtype = np.uint16 if (tokens.size == 0 or tokens.max() < 2**16) else np.uint32
+    header = np.zeros((), _HEADER)
+    header["magic"] = _MAGIC
+    header["version"] = 1
+    header["dtype_bytes"] = dtype().itemsize
+    header["n_tokens"] = tokens.size
+    with open(path, "wb") as f:
+        f.write(header.tobytes())
+        f.write(tokens.astype(dtype).tobytes())
+
+
+_build_lock = threading.Lock()
+_lib: Any = None
+_lib_failed = False
+
+
+def _native_lib() -> Any | None:
+    """Compile (once) and load the native loader; None if unavailable."""
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    with _build_lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        src = os.path.join(_REPO_NATIVE, "tadnn_loader.cpp")
+        so = os.path.join(_REPO_NATIVE, "libtadnn_loader.so")
+        try:
+            if (
+                not os.path.exists(so)
+                or os.path.getmtime(so) < os.path.getmtime(src)
+            ):
+                # compile to a private temp path, then atomically publish:
+                # concurrent processes each build their own temp and the
+                # last os.replace wins — no half-written .so is ever
+                # visible (and so never cached by the mtime check)
+                tmp = f"{so}.{os.getpid()}.tmp"
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                     "-pthread", src, "-o", tmp],
+                    check=True, capture_output=True, timeout=120,
+                )
+                os.replace(tmp, so)
+            lib = ctypes.CDLL(so)
+            lib.tadnn_loader_open.restype = ctypes.c_void_p
+            lib.tadnn_loader_open.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_uint64, ctypes.c_int,
+            ]
+            lib.tadnn_loader_n_windows.restype = ctypes.c_int64
+            lib.tadnn_loader_n_windows.argtypes = [ctypes.c_void_p]
+            lib.tadnn_loader_batch.restype = ctypes.c_int
+            lib.tadnn_loader_batch.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_uint32),
+            ]
+            lib.tadnn_loader_close.argtypes = [ctypes.c_void_p]
+            _lib = lib
+        except (OSError, subprocess.SubprocessError):
+            _lib_failed = True
+    return _lib
+
+
+class TokenFileDataset:
+    """Step-indexed LM batches from a TADN token file.
+
+    ``batch(i)`` -> ``{"input_ids": int32 [batch, seq_len+1]}`` — the
+    ``seq_len+1`` window feeds next_token_loss's shift.  ``backend`` is
+    'auto' (native if it builds, else numpy), 'native' (error if the C++
+    loader is unavailable) or 'numpy'.
+    """
+
+    step_indexed = True  # Trainer protocol: .batch(i) is keyed by step
+
+    def __init__(
+        self,
+        path: str,
+        seq_len: int,
+        batch_size: int,
+        *,
+        seed: int = 0,
+        backend: str = "auto",
+        prefetch: int = 4,
+    ):
+        self.path = path
+        self.seq_len = seq_len
+        self.batch_size = batch_size
+        self.seed = seed & _MASK64
+
+        header_arr = np.fromfile(path, dtype=_HEADER, count=1)
+        if (
+            header_arr.size != 1
+            or header_arr[0]["magic"] != _MAGIC
+            or header_arr[0]["version"] != 1
+            or header_arr[0]["dtype_bytes"] not in (2, 4)
+        ):
+            raise ValueError(f"{path} is not a TADN v1 token file")
+        header = header_arr[0]
+        self.n_tokens = int(header["n_tokens"])
+        self._dtype = np.uint16 if header["dtype_bytes"] == 2 else np.uint32
+        if self.n_tokens < seq_len + 1:
+            raise ValueError(
+                f"{path}: {self.n_tokens} tokens < one window ({seq_len + 1})"
+            )
+        self.n_windows = (self.n_tokens - 1) // seq_len
+
+        self._handle = None
+        self._tokens = None
+        lib = _native_lib() if backend in ("auto", "native") else None
+        if lib is not None:
+            self._handle = lib.tadnn_loader_open(
+                path.encode(), seq_len, batch_size, self.seed, prefetch
+            )
+        if backend == "native" and not self._handle:
+            raise RuntimeError("native loader unavailable (g++ build failed?)")
+        if not self._handle:
+            self._tokens = np.memmap(
+                path, dtype=self._dtype, mode="r",
+                offset=_HEADER.itemsize, shape=(self.n_tokens,),
+            )
+
+    @property
+    def backend(self) -> str:
+        return "native" if self._handle else "numpy"
+
+    def _epoch_params(self, epoch: int) -> tuple[int, int]:
+        s = _splitmix64(
+            (self.seed ^ ((epoch * 0x5851F42D4C957F2D + 1) & _MASK64))
+            & _MASK64
+        )
+        a = (_splitmix64(s) % self.n_windows) | 1
+        while np.gcd(a, self.n_windows) != 1:
+            a += 2
+        a = a % self.n_windows or 1
+        c = _splitmix64((s + 1) & _MASK64) % self.n_windows
+        return a, c
+
+    def _window_start(self, global_row: int) -> int:
+        epoch, w = divmod(global_row, self.n_windows)
+        a, c = self._epoch_params(epoch)
+        return ((a * w + c) % self.n_windows) * self.seq_len
+
+    def batch(self, step: int) -> dict:
+        width = self.seq_len + 1
+        # int32 buffer filled in place (tokens < 2^31, so the uint32 view
+        # the native side writes through is layout-identical — no copy)
+        out = np.empty((self.batch_size, width), np.int32)
+        if self._handle:
+            rc = _native_lib().tadnn_loader_batch(
+                self._handle, step,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            )
+            if rc != 0:
+                raise RuntimeError(f"native loader failed at step {step}")
+        else:
+            for r in range(self.batch_size):
+                start = self._window_start(step * self.batch_size + r)
+                out[r] = self._tokens[start:start + width]
+        return {"input_ids": out}
+
+    def __iter__(self):
+        step = 0
+        while True:
+            yield self.batch(step)
+            step += 1
+
+    def close(self) -> None:
+        if self._handle:
+            _native_lib().tadnn_loader_close(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def shard_for_host(batch: dict, *, process_index: int | None = None,
+                   process_count: int | None = None) -> dict:
+    """Slice a global batch to this host's rows (multi-host input path).
+
+    Each host feeds its slice to
+    ``jax.make_array_from_process_local_data`` (SURVEY.md C13); on one
+    host this is the identity.
+    """
+    import jax
+
+    pi = jax.process_index() if process_index is None else process_index
+    pc = jax.process_count() if process_count is None else process_count
+    if pc == 1:
+        return batch
+
+    def slc(x):
+        n = x.shape[0]
+        if n % pc:
+            raise ValueError(f"batch dim {n} not divisible by {pc} hosts")
+        per = n // pc
+        return x[pi * per:(pi + 1) * per]
+
+    return {k: slc(v) for k, v in batch.items()}
