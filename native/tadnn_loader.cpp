@@ -133,7 +133,11 @@ struct Loader {
         int64_t step = base + d;
         int slot = static_cast<int>(step % depth);
         if (ring_step[slot].load(std::memory_order_acquire) != step) {
-          ring_step[slot].store(kFilling, std::memory_order_release);
+          ring_step[slot].store(kFilling, std::memory_order_relaxed);
+          // full fence: the kFilling store must become visible before
+          // any of fill()'s plain data writes (store-store barrier), or
+          // a consumer's torn copy could pass its re-check
+          std::atomic_thread_fence(std::memory_order_seq_cst);
           fill(step, ring[slot].data());
           ring_step[slot].store(step, std::memory_order_release);
           did = true;
@@ -169,6 +173,11 @@ void* tadnn_loader_open(const char* path, int64_t seq_len, int64_t batch,
   if (h->magic != kMagic || h->version != 1 ||
       (h->dtype_bytes != 2 && h->dtype_bytes != 4)) {
     munmap(map, st.st_size);
+    close(fd);
+    return nullptr;
+  }
+  if (h->n_tokens > (UINT64_MAX - sizeof(Header)) / h->dtype_bytes) {
+    munmap(map, st.st_size);  // header would overflow the size check
     close(fd);
     return nullptr;
   }
@@ -222,7 +231,13 @@ int tadnn_loader_batch(void* handle, int64_t step, uint32_t* out) {
           L->ring_step[slot].load(std::memory_order_relaxed) == step;
     }
     if (!served) L->fill(step, out);
-    L->want.store(step + 1, std::memory_order_release);
+    // monotonic max: replaying an old step (elastic resume) must not
+    // rewind the ring and discard prefetched future batches
+    int64_t cur = L->want.load(std::memory_order_relaxed);
+    while (cur < step + 1 &&
+           !L->want.compare_exchange_weak(cur, step + 1,
+                                          std::memory_order_release)) {
+    }
     L->cv.notify_one();
   } else {
     L->fill(step, out);

@@ -138,6 +138,10 @@ class TokenFileDataset:
         backend: str = "auto",
         prefetch: int = 4,
     ):
+        if backend not in ("auto", "native", "numpy"):
+            raise ValueError(
+                f"backend must be 'auto', 'native' or 'numpy', got {backend!r}"
+            )
         self.path = path
         self.seq_len = seq_len
         self.batch_size = batch_size

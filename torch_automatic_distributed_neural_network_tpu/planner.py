@@ -539,6 +539,23 @@ def make_plan(
             )
     if remat is None:
         remat = resolved in ("fsdp", "tp_fsdp", "ep_fsdp")
+        if not remat:
+            # Replicated params (dp/tp/ep): turn checkpointing on when
+            # the per-device train state (params+grads+2 adam moments,
+            # fp32, after tensor/expert/pipe sharding) eats half a chip's
+            # HBM — activations would not fit otherwise.
+            pb = tree_bytes(abstract_params)
+            e_deg = degrees_final.get("expert", 1)
+            if e_deg > 1:
+                eb = sum(
+                    math.prod(leaf.shape)
+                    * np.dtype(getattr(leaf, "dtype", np.float32)).itemsize
+                    for _, leaf in _expert_banks(abstract_params)
+                )
+                pb = (pb - eb) + eb // e_deg
+            pb //= max(1, degrees_final.get("tensor", 1))
+            pb //= max(1, degrees_final.get("pipe", 1))
+            remat = 4 * pb > 0.5 * _hbm_bytes(topo.device_kind)
     return ShardPlan(
         mesh=mesh,
         strategy=resolved,
