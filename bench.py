@@ -8,8 +8,9 @@ measured MFU / the 40%-MFU north-star target (BASELINE.json:5), so 1.0
 means "hit the target".  Everything else goes to stderr.
 
 Flags (key=value):
-    model=medium|small|large|1p3b   seq=1024  batch=8  steps=50  strategy=auto
-    mode=gpt2|resnet|collectives
+    model=medium|small|large|1p3b (gpt2) / test|nano|small|mixtral_tiny (moe)
+    seq=1024  batch=8  steps=50  strategy=auto
+    mode=gpt2|resnet|moe|collectives
 """
 
 import json
@@ -70,6 +71,46 @@ def parse_args():
     return args
 
 
+def timed_lm_bench(ad, data, *, flop_params, seq, batch, steps):
+    """Shared LM benchmark core: init+compile, warm, timed chain, MFU.
+
+    ``flop_params`` is the parameter count the 6NT FLOP model uses —
+    total params for dense LMs, *active* params for MoE.  Returns
+    (tokens/s/chip, mfu, step_seconds, n_chips).
+    """
+    import jax
+
+    import torch_automatic_distributed_neural_network_tpu as tad
+    from torch_automatic_distributed_neural_network_tpu.training import (
+        peak_flops_per_chip,
+        transformer_step_flops,
+    )
+
+    t0 = time.perf_counter()
+    state = ad.init(jax.random.key(0), data.batch(0))
+    state, m = ad.step(state, data.batch(0))  # compile
+    float(m["loss"])
+    log(f"compile+init: {time.perf_counter()-t0:.1f}s "
+        f"plan={ad.plan.strategy} mesh={tad.mesh_degrees(ad.plan.mesh)}")
+    for i in range(2):  # warmup
+        state, m = ad.step(state, data.batch(i))
+    float(m["loss"])
+
+    batches = [data.batch(i) for i in range(steps)]
+    state, dt = timed_chain(ad.step, state, batches)
+    n_chips = jax.device_count()
+    tokens_per_step = batch * seq
+    tps_chip = tokens_per_step / dt / n_chips
+    # 6NT fwd+bwd; remat recomputes the forward -> 8NT of hardware FLOPs
+    flops_mult = 8.0 / 6.0 if ad.plan.remat else 1.0
+    flops = transformer_step_flops(flop_params, tokens_per_step) * flops_mult
+    mfu = flops / dt / (peak_flops_per_chip() * n_chips)
+    log(f"mean step {dt*1e3:.1f}ms  {tps_chip:,.0f} tokens/s/chip  "
+        f"MFU {mfu:.1%} (remat={'on' if ad.plan.remat else 'off'}, "
+        f"strategy={ad.plan.strategy})")
+    return tps_chip, mfu, dt, n_chips
+
+
 def bench_gpt2(args):
     import jax
     import optax
@@ -84,8 +125,6 @@ def bench_gpt2(args):
     )
     from torch_automatic_distributed_neural_network_tpu.training import (
         next_token_loss,
-        peak_flops_per_chip,
-        transformer_step_flops,
     )
 
     seq, batch, steps = args["seq"], args["batch"], args["steps"]
@@ -102,29 +141,10 @@ def bench_gpt2(args):
         loss_fn=next_token_loss,
         strategy=args["strategy"],
     )
-    t0 = time.perf_counter()
-    state = ad.init(jax.random.key(0), data.batch(0))
-    b = data.batch(0)
-    state, m = ad.step(state, b)  # compile
-    float(m["loss"])
-    log(f"compile+init: {time.perf_counter()-t0:.1f}s "
-        f"plan={ad.plan.strategy} mesh={tad.mesh_degrees(ad.plan.mesh)}")
-
-    # warmup
-    for i in range(2):
-        state, m = ad.step(state, data.batch(i))
-    float(m["loss"])
-
-    batches = [data.batch(i) for i in range(steps)]
-    state, dt = timed_chain(ad.step, state, batches)
-    n_chips = jax.device_count()
-    tokens_per_step = batch * seq
-    tps_chip = tokens_per_step / dt / n_chips
-    flops_mult = 8.0 / 6.0 if ad.plan.remat else 1.0
-    flops = transformer_step_flops(mcfg.num_params(), tokens_per_step) * flops_mult
-    mfu = flops / dt / (peak_flops_per_chip() * n_chips)
-    log(f"mean step {dt*1e3:.1f}ms  {tps_chip:,.0f} tokens/s/chip  "
-        f"MFU {mfu:.1%} (remat={'on' if ad.plan.remat else 'off'})")
+    tps_chip, mfu, dt, n_chips = timed_lm_bench(
+        ad, data, flop_params=mcfg.num_params(), seq=seq, batch=batch,
+        steps=steps,
+    )
     return {
         "metric": f"gpt2_{args['model']}_tokens_per_sec_per_chip",
         "value": round(tps_chip, 1),
@@ -139,6 +159,55 @@ def bench_gpt2(args):
             "n_chips": n_chips,
             "strategy": ad.plan.strategy,
         },
+    }
+
+
+def bench_moe(args):
+    import optax
+
+    import torch_automatic_distributed_neural_network_tpu as tad
+    from torch_automatic_distributed_neural_network_tpu.data.synthetic import (
+        SyntheticLM,
+    )
+    from torch_automatic_distributed_neural_network_tpu.models import (
+        MoE,
+        moe_config,
+    )
+    from torch_automatic_distributed_neural_network_tpu.training import (
+        moe_next_token_loss,
+    )
+
+    moe_sizes = ("test", "nano", "small", "mixtral_tiny")
+    size = args["model"]
+    if size not in moe_sizes:
+        size = "nano"
+        log(f"mode=moe: model={args['model']!r} is not a MoE preset "
+            f"{moe_sizes}; using {size!r}")
+    seq, batch, steps = args["seq"], args["batch"], args["steps"]
+    mcfg = moe_config(size, max_seq_len=seq)
+    log(f"bench: MoE {size} ({mcfg.num_params()/1e6:.0f}M total / "
+        f"{mcfg.active_params()/1e6:.0f}M active) seq={seq} batch={batch}")
+    data = SyntheticLM(vocab_size=mcfg.vocab_size, seq_len=seq + 1,
+                       batch_size=batch)
+    ad = tad.AutoDistribute(
+        MoE(size, max_seq_len=seq),
+        optimizer=optax.adamw(1e-4),
+        loss_fn=moe_next_token_loss,
+        strategy=args["strategy"],
+    )
+    # MFU on *active* params (top-k of E experts touched per token)
+    tps_chip, mfu, dt, _ = timed_lm_bench(
+        ad, data, flop_params=mcfg.active_params(), seq=seq, batch=batch,
+        steps=steps,
+    )
+    return {
+        "metric": f"moe_{size}_tokens_per_sec_per_chip",
+        "value": round(tps_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "extra": {"mfu_active": round(mfu, 4), "strategy": ad.plan.strategy,
+                  "n_experts": mcfg.n_experts, "top_k": mcfg.top_k,
+                  "step_time_ms": round(dt * 1e3, 2)},
     }
 
 
@@ -209,7 +278,7 @@ def bench_collectives(args):
 
 def main():
     args = parse_args()
-    fn = {"gpt2": bench_gpt2, "resnet": bench_resnet,
+    fn = {"gpt2": bench_gpt2, "resnet": bench_resnet, "moe": bench_moe,
           "collectives": bench_collectives}[args["mode"]]
     result = fn(args)
     print(json.dumps(result), flush=True)

@@ -1,0 +1,176 @@
+"""Cross-framework numerics oracle (SURVEY.md §4 'Torch cross-check').
+
+An independent PyTorch implementation of the decoder families is fed the
+*identical* weights from the flax models; logits and input-embedding
+gradients must agree to fp32 tolerance.  This catches convention bugs
+(scaling, masking, gelu variant, norm eps, rope layout, GQA broadcast)
+that single-framework parity tests cannot see.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from torch_automatic_distributed_neural_network_tpu.models import (
+    GPT2,
+    Llama,
+)
+
+@pytest.fixture(autouse=True)
+def _float64_default():
+    """Tight fp64 oracle, scoped so other test modules keep torch's
+    default dtype."""
+    prev = torch.get_default_dtype()
+    torch.set_default_dtype(torch.float64)
+    yield
+    torch.set_default_dtype(prev)
+
+
+def _np(x):
+    return np.asarray(x, dtype=np.float64)
+
+
+def _layer(params, name, idx):
+    """Slice layer `idx` out of the scanned [L, ...] parameter stack."""
+    return jax.tree.map(lambda x: _np(x)[idx], params["layers"][name])
+
+
+def _layernorm(x, scale, bias, eps=1e-5):
+    mu = x.mean(-1, keepdim=True)
+    var = x.var(-1, unbiased=False, keepdim=True)
+    return (x - mu) / torch.sqrt(var + eps) * scale + bias
+
+
+def _rmsnorm(x, scale, eps=1e-5):
+    ms = (x * x).mean(-1, keepdim=True)
+    return x / torch.sqrt(ms + eps) * scale
+
+
+def _rope(x, positions, theta):
+    # rotate-half formulation, matching transformer_core.rope
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (np.arange(0, d, 2, dtype=np.float64) / d))
+    angles = positions[..., None].double() * torch.as_tensor(freqs)
+    cos = torch.cos(angles)[:, :, None, :]
+    sin = torch.sin(angles)[:, :, None, :]
+    x1, x2 = x.chunk(2, dim=-1)
+    return torch.cat([x1 * cos - x2 * sin, x2 * cos + x1 * sin], dim=-1)
+
+
+def _attention(q, k, v, causal=True):
+    # [B, S, H, D]; GQA broadcast + 1/sqrt(d) fp softmax
+    hq, hk = q.shape[2], k.shape[2]
+    if hk != hq:
+        k = k.repeat_interleave(hq // hk, dim=2)
+        v = v.repeat_interleave(hq // hk, dim=2)
+    d = q.shape[-1]
+    scores = torch.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+    if causal:
+        s = q.shape[1]
+        neg = torch.full((s, s), float("-inf"))
+        scores = scores + torch.triu(neg, diagonal=1)
+    probs = torch.softmax(scores, dim=-1)
+    return torch.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _torch_decoder(params, cfg, tokens):
+    """Independent re-implementation of models/transformer_core.DecoderLM."""
+    def t(a):
+        return a if isinstance(a, torch.Tensor) else torch.as_tensor(_np(a))
+
+    B, S = tokens.shape
+    emb = t(params["embed"]["embedding"])
+    x = emb[tokens]
+    positions = torch.arange(S)[None, :].expand(B, S)
+    if cfg.pos == "learned":
+        x = x + t(params["pos_embed"])[None, :S]
+
+    ln = _layernorm if cfg.norm == "layernorm" else _rmsnorm
+    bias_on = cfg.norm == "layernorm"
+
+    for i in range(cfg.n_layers):
+        def dense(p, h, fold_out=False):
+            kernel = t(p["kernel"])
+            if fold_out:
+                out = torch.einsum("bshe,hed->bsd", h, kernel)
+            elif kernel.ndim == 3:
+                out = torch.einsum("bsd,dhe->bshe", h, kernel)
+            else:
+                out = torch.einsum("bsd,df->bsf", h, kernel)
+            if bias_on and "bias" in p:
+                out = out + t(p["bias"])
+            return out
+
+        an = _layer(params, "attn_norm", i)
+        h = (ln(x, torch.as_tensor(an["scale"]), torch.as_tensor(an["bias"]))
+             if bias_on else ln(x, torch.as_tensor(an["scale"])))
+        attn = _layer(params, "attn", i)
+        q = dense(attn["q_proj"], h)   # [B, S, H, hd]
+        k = dense(attn["k_proj"], h)
+        v = dense(attn["v_proj"], h)
+        if cfg.pos == "rope":
+            q = _rope(q, positions, cfg.rope_theta)
+            k = _rope(k, positions, cfg.rope_theta)
+        o = _attention(q, k, v, causal=True)
+        x = x + dense(attn["o_proj"], o, fold_out=True)
+
+        mn = _layer(params, "mlp_norm", i)
+        h = (ln(x, torch.as_tensor(mn["scale"]), torch.as_tensor(mn["bias"]))
+             if bias_on else ln(x, torch.as_tensor(mn["scale"])))
+        mlp = _layer(params, "mlp", i)
+        if cfg.act == "swiglu":
+            hidden = F.silu(dense(mlp["gate_proj"], h)) * dense(mlp["up_proj"], h)
+        else:
+            hidden = F.gelu(dense(mlp["up_proj"], h), approximate="tanh")
+        x = x + dense(mlp["down_proj"], hidden)
+
+    fn = params["final_norm"]
+    x = (ln(x, t(fn["scale"]), t(fn["bias"]))
+         if bias_on else ln(x, t(fn["scale"])))
+    if cfg.tie_embeddings:
+        return x @ emb.T
+    return torch.einsum("bsd,dv->bsv", x, t(params["lm_head"]["kernel"]))
+
+
+@pytest.mark.parametrize("family", ["gpt2", "llama"])
+def test_logits_match_torch(family):
+    make = GPT2 if family == "gpt2" else Llama
+    model = make("test", vocab_size=128, max_seq_len=32,
+                 dtype=jnp.float32, remat=False)
+    cfg = model.cfg
+    tokens = np.random.RandomState(0).randint(0, 128, size=(2, 32))
+    variables = model.init(jax.random.key(1), jnp.asarray(tokens))
+    jax_logits = np.asarray(model.apply(variables, jnp.asarray(tokens)))
+
+    torch_logits = _torch_decoder(
+        variables["params"], cfg, torch.as_tensor(tokens)
+    ).numpy()
+    np.testing.assert_allclose(jax_logits, torch_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_grads_match_torch():
+    model = GPT2("test", vocab_size=128, max_seq_len=32,
+                 dtype=jnp.float32, remat=False)
+    cfg = model.cfg
+    tokens = np.random.RandomState(2).randint(0, 128, size=(2, 32))
+    variables = model.init(jax.random.key(3), jnp.asarray(tokens))
+
+    def jax_loss(pos_embed):
+        params = {**variables["params"], "pos_embed": pos_embed}
+        logits = model.apply({"params": params}, jnp.asarray(tokens))
+        return jnp.mean(jax.nn.log_softmax(logits)[..., 0])
+
+    jax_grad = np.asarray(jax.grad(jax_loss)(variables["params"]["pos_embed"]))
+
+    pe = torch.as_tensor(_np(variables["params"]["pos_embed"]))
+    pe.requires_grad_(True)
+    params = dict(variables["params"])
+    params = {**params, "pos_embed": pe}
+    logits = _torch_decoder(params, cfg, torch.as_tensor(tokens))
+    torch.mean(torch.log_softmax(logits, dim=-1)[..., 0]).backward()
+    np.testing.assert_allclose(
+        jax_grad, pe.grad.numpy(), rtol=2e-4, atol=2e-5
+    )
