@@ -167,8 +167,7 @@ def test_grads_match_torch():
 
     pe = torch.as_tensor(_np(variables["params"]["pos_embed"]))
     pe.requires_grad_(True)
-    params = dict(variables["params"])
-    params = {**params, "pos_embed": pe}
+    params = {**variables["params"], "pos_embed": pe}
     logits = _torch_decoder(params, cfg, torch.as_tensor(tokens))
     torch.mean(torch.log_softmax(logits, dim=-1)[..., 0]).backward()
     np.testing.assert_allclose(

@@ -1,0 +1,5 @@
+"""Inference: KV-cached autoregressive decoding for the decoder families."""
+
+from .decode import KVCache, SampleConfig, forward_cached, generate
+
+__all__ = ["KVCache", "SampleConfig", "forward_cached", "generate"]

@@ -1,0 +1,217 @@
+"""KV-cached autoregressive decoding (inference path for the C12 models).
+
+The training path (models/transformer_core.py) is jit-compiled over full
+sequences; decoding re-runs the same weights through a functional cache:
+
+- ``prefill``: one chunked pass over the prompt that both computes logits
+  and writes the KV cache — O(prompt) attention, no per-token loop;
+- ``decode_step``: a single-token step against the cache — the lax.scan
+  body of :func:`generate`, so the whole generation loop is ONE compiled
+  program (no Python in the loop, XLA-friendly static shapes).
+
+The cache is an explicit pytree (no flax mutable collections), so it
+shards like any other activation: [L, B, S_max, kvH, hd] with batch on
+the data axes.  Works for both decoder families (GPT-2: layernorm /
+learned-pos / gelu / tied; Llama: rmsnorm / rope / swiglu / GQA /
+untied).  MoE decode is not implemented yet (routing under a cache is a
+separate path).
+
+Numerics are cross-checked against ``model.apply`` on the full prefix in
+tests/test_generate.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.transformer_core import TransformerConfig, rope
+
+
+class KVCache(NamedTuple):
+    """Per-layer stacked KV: [n_layers, B, S_max, kv_heads, head_dim]."""
+
+    k: jax.Array
+    v: jax.Array
+    length: jax.Array  # scalar int32: tokens already cached
+
+    @classmethod
+    def init(cls, cfg: TransformerConfig, batch: int, max_len: int,
+             dtype=jnp.bfloat16) -> "KVCache":
+        shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
+        return cls(
+            k=jnp.zeros(shape, dtype),
+            v=jnp.zeros(shape, dtype),
+            length=jnp.zeros((), jnp.int32),
+        )
+
+
+def _norm(x, p, kind):
+    x32 = x.astype(jnp.float32)
+    if kind == "rmsnorm":
+        y = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, -1, keepdims=True) + 1e-5)
+        return (y * p["scale"]).astype(x.dtype)
+    mu = jnp.mean(x32, -1, keepdims=True)
+    var = jnp.var(x32, -1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + 1e-5)
+    return (y * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def _dense(p, h, *, fold_out=False, bias: bool):
+    kernel = p["kernel"].astype(h.dtype)
+    if fold_out:
+        out = jnp.einsum("bthe,hed->btd", h, kernel)
+    elif kernel.ndim == 3:
+        out = jnp.einsum("btd,dhe->bthe", h, kernel)
+    else:
+        out = jnp.einsum("btd,df->btf", h, kernel)
+    if bias and "bias" in p:
+        out = out + p["bias"].astype(out.dtype)
+    return out
+
+
+def _cached_attention(q, k_cache, v_cache, q_pos, kv_len):
+    """q: [B, T, H, hd] at absolute positions q_pos..q_pos+T-1;
+    k/v_cache: [B, S_max, kvH, hd] with kv_len entries valid (the current
+    chunk already written).  Causality over absolute positions is encoded
+    in the mask; the numerics (GQA broadcast, fp32 softmax, mask bias)
+    are ops/attention.xla_attention's."""
+    from ..ops.attention import xla_attention
+
+    T = q.shape[1]
+    S = k_cache.shape[1]
+    key_idx = jnp.arange(S)[None, :]
+    q_idx = (q_pos + jnp.arange(T))[:, None]
+    mask = (key_idx <= q_idx) & (key_idx < kv_len)  # [T, S]
+    return xla_attention(q, k_cache, v_cache, causal=False,
+                         mask=mask[None, None])
+
+
+def forward_cached(
+    params: Any,
+    cfg: TransformerConfig,
+    tokens: jax.Array,  # [B, T] chunk (prompt at prefill, 1 token after)
+    cache: KVCache,
+) -> tuple[jax.Array, KVCache]:
+    """Run the decoder on a chunk against the cache; returns (logits of
+    the chunk's last position [B, vocab], updated cache)."""
+    if "layers" not in params:
+        raise ValueError(
+            "forward_cached needs the scanned parameter layout (a stacked "
+            "'layers' entry); this model was built with scan_layers=False "
+            "(layers_0..layers_N params), which the decode path does not "
+            "support"
+        )
+    B, T = tokens.shape
+    pos0 = cache.length
+    dtype = cfg.dtype
+    bias = cfg.norm == "layernorm"
+
+    x = params["embed"]["embedding"].astype(dtype)[tokens]
+    positions = pos0 + jnp.arange(T)[None, :]
+    if cfg.pos == "learned":
+        pe = params["pos_embed"].astype(dtype)
+        x = x + jax.lax.dynamic_slice_in_dim(pe, pos0, T, axis=0)[None]
+
+    def layer(x, layer_params_and_kv):
+        lp, k_cache, v_cache = layer_params_and_kv
+        h = _norm(x, lp["attn_norm"], cfg.norm)
+        q = _dense(lp["attn"]["q_proj"], h, bias=bias)
+        k = _dense(lp["attn"]["k_proj"], h, bias=bias)
+        v = _dense(lp["attn"]["v_proj"], h, bias=bias)
+        if cfg.pos == "rope":
+            q = rope(q, positions, cfg.rope_theta)
+            k = rope(k, positions, cfg.rope_theta)
+        k_cache = jax.lax.dynamic_update_slice_in_dim(
+            k_cache, k.astype(k_cache.dtype), pos0, axis=1)
+        v_cache = jax.lax.dynamic_update_slice_in_dim(
+            v_cache, v.astype(v_cache.dtype), pos0, axis=1)
+        o = _cached_attention(q, k_cache, v_cache, pos0, pos0 + T)
+        x = x + _dense(lp["attn"]["o_proj"], o.astype(dtype),
+                       fold_out=True, bias=bias)
+        h = _norm(x, lp["mlp_norm"], cfg.norm)
+        if cfg.act == "swiglu":
+            hidden = jax.nn.silu(_dense(lp["mlp"]["gate_proj"], h, bias=bias))
+            hidden = hidden * _dense(lp["mlp"]["up_proj"], h, bias=bias)
+        else:
+            hidden = jax.nn.gelu(_dense(lp["mlp"]["up_proj"], h, bias=bias))
+        x = x + _dense(lp["mlp"]["down_proj"], hidden, bias=bias)
+        return x, (k_cache, v_cache)
+
+    def scan_body(x, xs):
+        x, kv = layer(x, xs)
+        return x, kv
+
+    x, (new_k, new_v) = jax.lax.scan(
+        scan_body, x, (params["layers"], cache.k, cache.v)
+    )
+
+    x = _norm(x, params["final_norm"], cfg.norm)
+    last = x[:, -1].astype(jnp.float32)
+    if cfg.tie_embeddings:
+        logits = last @ params["embed"]["embedding"].astype(jnp.float32).T
+    else:
+        logits = last @ params["lm_head"]["kernel"].astype(jnp.float32)
+    new_cache = KVCache(k=new_k, v=new_v, length=pos0 + T)
+    return logits, new_cache
+
+
+@dataclasses.dataclass(frozen=True)
+class SampleConfig:
+    temperature: float = 1.0  # 0 -> greedy
+    top_k: int = 0  # 0 -> full distribution
+
+
+def _sample(logits: jax.Array, rng: jax.Array, sc: SampleConfig) -> jax.Array:
+    if sc.temperature == 0.0:
+        return jnp.argmax(logits, -1).astype(jnp.int32)
+    logits = logits / sc.temperature
+    if sc.top_k:
+        kth = jnp.sort(logits, -1)[:, -sc.top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits).astype(jnp.int32)
+
+
+def generate(
+    model,
+    variables: Any,
+    prompt: jax.Array,  # [B, P] int32
+    *,
+    max_new_tokens: int,
+    sample: SampleConfig = SampleConfig(temperature=0.0),
+    rng: jax.Array | None = None,
+    cache_dtype=jnp.bfloat16,
+) -> jax.Array:
+    """Autoregressive generation: prefill + one-token lax.scan decode.
+
+    Returns [B, P + max_new_tokens].  The whole loop compiles to a single
+    XLA program; re-invoking with the same shapes reuses the executable.
+    """
+    cfg: TransformerConfig = model.cfg
+    params = variables["params"]
+    B, P = prompt.shape
+    if max_new_tokens < 1:
+        return prompt
+    rng = jax.random.key(0) if rng is None else rng
+    rng, first_rng = jax.random.split(rng)
+
+    cache = KVCache.init(cfg, B, P + max_new_tokens, dtype=cache_dtype)
+    logits, cache = forward_cached(params, cfg, prompt, cache)
+    first = _sample(logits, first_rng, sample)
+
+    def body(carry, step_rng):
+        cache, tok = carry
+        logits, cache = forward_cached(params, cfg, tok[:, None], cache)
+        nxt = _sample(logits, step_rng, sample)
+        return (cache, nxt), nxt
+
+    if max_new_tokens > 1:
+        (_, _), rest = jax.lax.scan(body, (cache, first),
+                                    jax.random.split(rng, max_new_tokens - 1))
+        new_tokens = jnp.concatenate([first[:, None], rest.T], axis=1)
+    else:
+        new_tokens = first[:, None]
+    return jnp.concatenate([prompt, new_tokens], axis=1)
