@@ -1,16 +1,21 @@
-"""Headline benchmark for the driver: GPT-2 tokens/sec/chip on real hardware.
+"""Headline benchmark for the driver: GPT-2 1.3B tokens/sec/chip on real
+hardware (the BASELINE.json:10 named config).
 
 Prints ONE JSON line to stdout:
     {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
 
 The reference publishes no numbers (BASELINE.md): ``vs_baseline`` is
 measured MFU / the 40%-MFU north-star target (BASELINE.json:5), so 1.0
-means "hit the target".  Everything else goes to stderr.
+means "hit the target".  MFU here is strict model-MFU — 6NT useful FLOPs
+only; activation recompute (remat) is credited only via the 8/6 multiplier
+when the *outer* loss-level checkpoint is on.  Everything else -> stderr.
 
 Flags (key=value):
-    model=medium|small|large|1p3b (gpt2) / test|nano|small|mixtral_tiny (moe)
-    seq=1024  batch=8  steps=50  strategy=auto
-    mode=gpt2|resnet|moe|collectives
+    model=1p3b|medium|small|large (gpt2) / test|nano|small|mixtral_tiny (moe)
+    seq=1024  batch=16  steps=30  strategy=auto
+    precision=bf16|mixed|fp32 (1p3b needs mixed or bf16 to fit 16 GB)
+    remat_policy=nothing|dots  remat=auto|on|off
+    mode=gpt2|resnet|moe|collectives|overlap
 """
 
 import json
@@ -60,10 +65,16 @@ def timed_chain(step, state, batches):
 
 def parse_args():
     args = {
-        # 50+ steps: short chains under-measure through the axon tunnel
-        # (10-step chains reported impossible >100% MFU; 50 steps is stable)
-        "model": "medium", "seq": 1024, "batch": 8, "steps": 50,
-        "strategy": "auto", "mode": "gpt2",
+        # >=30 chained steps: short chains under-measure through the axon
+        # tunnel (10-step chains reported impossible >100% MFU)
+        "model": "1p3b", "seq": 1024, "batch": 16, "steps": 30,
+        "strategy": "auto", "mode": "gpt2", "precision": "bf16",
+        # remat_policy steers the model's per-layer checkpointing; remat
+        # auto|on|off steers the planner's outer loss-level checkpoint
+        # (off for 1p3b: the per-layer 'nothing' policy already bounds
+        # activations, and an outer dots-policy checkpoint would re-save
+        # every MLP hidden across the scan — 3 GB on 1.3B).
+        "remat_policy": "nothing", "remat": "off",
     }
     for item in sys.argv[1:]:
         k, _, v = item.partition("=")
@@ -135,11 +146,15 @@ def bench_gpt2(args):
 
     data = SyntheticLM(vocab_size=mcfg.vocab_size, seq_len=seq + 1,
                        batch_size=batch)
+    remat = {"auto": None, "on": True, "off": False}[args["remat"]]
     ad = tad.AutoDistribute(
-        GPT2(args["model"], max_seq_len=seq),
+        GPT2(args["model"], max_seq_len=seq,
+             remat_policy=args["remat_policy"]),
         optimizer=optax.adamw(1e-4),
         loss_fn=next_token_loss,
         strategy=args["strategy"],
+        precision=args["precision"],
+        remat=remat,
     )
     tps_chip, mfu, dt, n_chips = timed_lm_bench(
         ad, data, flop_params=mcfg.num_params(), seq=seq, batch=batch,
@@ -158,6 +173,8 @@ def bench_gpt2(args):
             "params_m": round(mcfg.num_params() / 1e6),
             "n_chips": n_chips,
             "strategy": ad.plan.strategy,
+            "precision": ad.precision.name,
+            "remat_policy": args["remat_policy"],
         },
     }
 
@@ -259,6 +276,76 @@ def bench_resnet(args):
     }
 
 
+def bench_overlap(args):
+    """C4: comm/compute overlap measurement (collectives.bench_overlap).
+
+    Needs >= 2 devices; under the 1-chip driver env it re-execs itself on
+    the 8-device CPU sim (methodology demo — the real signal is a
+    multi-chip TPU run with LATENCY_HIDING_XLA_FLAGS set).
+    """
+    import jax
+
+    if jax.device_count() < 2:
+        import os
+        import subprocess
+
+        from torch_automatic_distributed_neural_network_tpu.parallel.collectives import (
+            LATENCY_HIDING_XLA_FLAGS,
+        )
+
+        env = dict(os.environ)
+        pythonpath = [
+            p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+            if p and "axon" not in p
+        ]
+        if pythonpath:
+            env["PYTHONPATH"] = os.pathsep.join(pythonpath)
+        else:
+            env.pop("PYTHONPATH", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = [
+            f for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
+        env["XLA_FLAGS"] = " ".join(
+            flags + ["--xla_force_host_platform_device_count=8"]
+        )
+        log(f"mode=overlap: 1 device visible; re-running on the 8-device "
+            f"CPU sim (on TPU pods set XLA_FLAGS={LATENCY_HIDING_XLA_FLAGS})")
+        proc = subprocess.run(
+            [sys.executable, __file__] + sys.argv[1:],
+            env=env, capture_output=True, text=True, timeout=1200,
+        )
+        sys.stderr.write(proc.stderr)
+        if proc.returncode != 0:
+            raise RuntimeError(f"CPU-sim overlap bench failed:\n{proc.stderr[-2000:]}")
+        print(proc.stdout, end="", flush=True)
+        raise SystemExit(0)
+
+    from torch_automatic_distributed_neural_network_tpu.parallel.collectives import (
+        bench_overlap as run_overlap,
+    )
+
+    r = run_overlap()
+    log(f"overlap on {r.n_devices} devices: compute {r.t_compute_s*1e3:.1f}ms "
+        f"comm {r.t_comm_s*1e3:.1f}ms both {r.t_both_s*1e3:.1f}ms "
+        f"-> {r.overlap_frac:.0%} of the cheaper phase hidden")
+    extra = r.to_json()
+    if jax.default_backend() == "cpu":
+        extra["note"] = (
+            "CPU-sim devices share host cores: t_both inflates from "
+            "oversubscription, so the fraction is a lower bound / "
+            "methodology demo; the real signal needs a multi-chip slice"
+        )
+    return {
+        "metric": "comm_compute_overlap_frac",
+        "value": round(r.overlap_frac, 4),
+        "unit": "fraction",
+        "vs_baseline": 0.0,
+        "extra": extra,
+    }
+
+
 def bench_collectives(args):
     from torch_automatic_distributed_neural_network_tpu.parallel.collectives import (
         bench_collective,
@@ -279,7 +366,7 @@ def bench_collectives(args):
 def main():
     args = parse_args()
     fn = {"gpt2": bench_gpt2, "resnet": bench_resnet, "moe": bench_moe,
-          "collectives": bench_collectives}[args["mode"]]
+          "collectives": bench_collectives, "overlap": bench_overlap}[args["mode"]]
     result = fn(args)
     print(json.dumps(result), flush=True)
 

@@ -1,0 +1,105 @@
+"""Megatron-SP: sequence-sharded activations inside TP blocks
+(SURVEY.md §2.2 SP row, §5 long-context tier 1).
+
+The residual stream between attention/MLP blocks carries a sharding
+constraint putting the *sequence* dim on the ``tensor`` axis
+(models/transformer_core.py via parallel/context.shard_activations), so
+GSPMD lowers block boundaries to all_gather + reduce_scatter instead of
+all_reduce over full-size activations.  Pinned here: (1) loss parity vs
+the dense 1-device oracle, (2) the compiled TP step actually contains a
+reduce-scatter (the SP signature), (3) the constraint is a no-op on
+trivial meshes and inside pipeline stages.
+"""
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+import torch_automatic_distributed_neural_network_tpu as tad
+from torch_automatic_distributed_neural_network_tpu.data.synthetic import SyntheticLM
+from torch_automatic_distributed_neural_network_tpu.models import GPT2
+from torch_automatic_distributed_neural_network_tpu.parallel import context as pctx
+from torch_automatic_distributed_neural_network_tpu.training import next_token_loss
+
+
+def run_tp(strategy, steps=3, devices=None, **kwargs):
+    data = SyntheticLM(vocab_size=512, seq_len=65, batch_size=8)
+    ad = tad.AutoDistribute(
+        GPT2("test", vocab_size=512, max_seq_len=64),
+        optimizer=optax.adam(1e-3),
+        loss_fn=next_token_loss,
+        strategy=strategy,
+        devices=devices,
+        **kwargs,
+    )
+    state = ad.init(jax.random.key(0), data.batch(0))
+    losses = []
+    for i in range(steps):
+        state, m = ad.step(state, data.batch(i))
+        losses.append(float(m["loss"]))
+    return losses, ad, state, data
+
+
+def test_sp_loss_parity_vs_dense(devices8):
+    l1, *_ = run_tp("dp", devices=[jax.devices()[0]])
+    ltp, ad, _, _ = run_tp("tp")
+    assert tad.mesh_degrees(ad.plan.mesh)["tensor"] == 8
+    np.testing.assert_allclose(l1, ltp, rtol=5e-4)
+
+
+def test_sp_constraint_in_lowered_step(devices8):
+    """The traced step carries seq-on-tensor sharding constraints on the
+    residual stream, and the partitioned program gathers at block entry.
+
+    (On the CPU backend GSPMD lowers the block-exit reduce-scatter to
+    all-reduce + dynamic-slice — the reduce-scatter-creator pass is a
+    TPU/GPU optimization — so the backend-independent assertions are the
+    sdy sharding constraint and the all-gather.)"""
+    _, ad, state, data = run_tp("tp", steps=1)
+    lowered = ad._step_fn.lower(state, data.batch(0))
+    txt = lowered.as_text()
+    assert "sdy.sharding_constraint" in txt, "no sharding constraints traced"
+    assert '[{}, {"tensor"}, {}]' in txt, (
+        "residual stream is not seq-sharded on the tensor axis"
+    )
+    hlo = lowered.compile().as_text()
+    assert "all-gather" in hlo, "no all-gather at TP block entry"
+
+
+def test_sp_activations_seq_sharded(devices8):
+    """The residual-stream constraint itself: a traced activation inside
+    the step carries seq-on-tensor sharding."""
+    mesh = tad.build_mesh(tensor=8)
+    ctx = pctx.ParallelContext(mesh=mesh)
+    spec = ctx.activation_spec()
+    assert spec[1] == "tensor", spec
+    # CP + TP compose: seq dim shards over both axes
+    mesh2 = tad.build_mesh(seq=2, tensor=4)
+    ctx2 = pctx.ParallelContext(mesh=mesh2)
+    assert ctx2.activation_spec()[1] == ("seq", "tensor")
+
+
+def test_sp_noop_on_trivial_mesh():
+    mesh = tad.build_mesh(devices=[jax.devices()[0]], data=1)
+    with pctx.use(pctx.ParallelContext(mesh=mesh)):
+        x = jax.numpy.ones((2, 8, 4))
+        y = pctx.shard_activations(x)
+    assert y is x
+
+
+def test_sp_disabled_inside_pipeline_context():
+    mesh = tad.build_mesh(tensor=min(8, len(jax.devices())))
+    with pctx.use(pctx.ParallelContext(mesh=mesh, enable_constraints=False)):
+        x = jax.numpy.ones((2, 8, 4))
+        y = pctx.shard_activations(x)
+    assert y is x
+
+
+def test_sp_with_tp_fsdp(devices8):
+    """tp_fsdp: batch on fsdp, seq on tensor — parity holds."""
+    l1, *_ = run_tp("dp", devices=[jax.devices()[0]])
+    lsp, ad, _, _ = run_tp("tp_fsdp")
+    d = tad.mesh_degrees(ad.plan.mesh)
+    assert d["tensor"] > 1 and d["fsdp"] > 1
+    np.testing.assert_allclose(l1, lsp, rtol=5e-4)

@@ -1,0 +1,93 @@
+"""Multi-host input assembly (SURVEY.md C13; VERDICT r1 missing #4).
+
+On a real multi-host slice each host holds only its row-slice of the
+global batch; ``AutoDistribute.shard_batch``/``step`` assemble global
+arrays via ``jax.make_array_from_process_local_data``.  A single process
+cannot run a real multi-host world, so these tests pin (1) the slice
+partition (every host's rows concatenate back to the global batch in
+order), (2) the assembly dispatch with a mocked process world, (3) the
+1-host identity path.
+"""
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+import torch_automatic_distributed_neural_network_tpu as tad
+from torch_automatic_distributed_neural_network_tpu import core as core_mod
+from torch_automatic_distributed_neural_network_tpu.data import shard_for_host
+from torch_automatic_distributed_neural_network_tpu.data.synthetic import SyntheticLM
+def test_host_slices_partition_the_global_batch():
+    global_batch = {"input_ids": np.arange(32 * 5).reshape(32, 5)}
+    for pc in (1, 2, 4, 8):
+        slices = [
+            shard_for_host(global_batch, process_index=pi, process_count=pc)
+            for pi in range(pc)
+        ]
+        rebuilt = np.concatenate([s["input_ids"] for s in slices], axis=0)
+        np.testing.assert_array_equal(rebuilt, global_batch["input_ids"])
+
+
+def test_indivisible_batch_rejected():
+    with pytest.raises(ValueError, match="not divisible"):
+        shard_for_host({"x": np.zeros((10, 3))}, process_index=0,
+                       process_count=4)
+
+
+def test_one_host_shard_batch_is_device_put(devices8):
+    from torch_automatic_distributed_neural_network_tpu.models import GPT2
+    from torch_automatic_distributed_neural_network_tpu.training import (
+        next_token_loss,
+    )
+
+    data = SyntheticLM(vocab_size=64, seq_len=9, batch_size=8)
+    ad = tad.AutoDistribute(
+        GPT2("test", vocab_size=64, max_seq_len=8),
+        optimizer=optax.sgd(1e-2), loss_fn=next_token_loss, strategy="dp",
+    )
+    ad.init(jax.random.key(0), data.batch(0))
+    out = ad.shard_batch(data.batch(0))
+    leaf = out["input_ids"]
+    assert isinstance(leaf, jax.Array)
+    assert leaf.sharding == ad.plan.batch_sharding()
+    np.testing.assert_array_equal(np.asarray(leaf), data.batch(0)["input_ids"])
+    # idempotent: an already-sharded leaf passes through by identity
+    again = ad.shard_batch(out)
+    assert again["input_ids"] is leaf
+
+
+def test_multihost_assembly_dispatch(devices8, monkeypatch):
+    """With a mocked 4-process world, shard_batch must route every numpy
+    leaf through make_array_from_process_local_data with the plan's batch
+    sharding and this host's slice."""
+    from torch_automatic_distributed_neural_network_tpu.models import GPT2
+    from torch_automatic_distributed_neural_network_tpu.training import (
+        next_token_loss,
+    )
+
+    data = SyntheticLM(vocab_size=64, seq_len=9, batch_size=8)
+    ad = tad.AutoDistribute(
+        GPT2("test", vocab_size=64, max_seq_len=8),
+        optimizer=optax.sgd(1e-2), loss_fn=next_token_loss, strategy="dp",
+    )
+    ad.init(jax.random.key(0), data.batch(0))
+
+    global_batch = data.batch(1)
+    local = shard_for_host(global_batch, process_index=2, process_count=4)
+    calls = []
+
+    def fake_assemble(sharding, local_data, **kw):
+        calls.append((sharding, np.asarray(local_data)))
+        return jax.device_put(local_data)  # stand-in global array
+
+    monkeypatch.setattr(core_mod.jax, "process_count", lambda: 4)
+    monkeypatch.setattr(
+        core_mod.jax, "make_array_from_process_local_data", fake_assemble
+    )
+    ad.shard_batch(local)
+    assert len(calls) == 1
+    sharding, local_data = calls[0]
+    assert sharding == ad.plan.batch_sharding()
+    np.testing.assert_array_equal(local_data, local["input_ids"])
+    assert local_data.shape[0] == global_batch["input_ids"].shape[0] // 4

@@ -1,0 +1,156 @@
+"""Mixed-precision train state (training/precision.py).
+
+The reference trains fp32 on CUDA; the mixed-precision capability analog is
+torch.cuda.amp / apex master weights (SURVEY.md C14).  These tests pin:
+dtype placement per preset, fp32-vs-mixed loss parity, and the planner's
+dtype-aware HBM accounting.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import torch_automatic_distributed_neural_network_tpu as tad
+from torch_automatic_distributed_neural_network_tpu.data.synthetic import SyntheticLM
+from torch_automatic_distributed_neural_network_tpu.models import GPT2
+from torch_automatic_distributed_neural_network_tpu.training import (
+    next_token_loss,
+)
+from torch_automatic_distributed_neural_network_tpu.training import precision as pmod
+
+
+def run_steps(precision, steps=4, strategy="dp", devices=None, **kwargs):
+    data = SyntheticLM(vocab_size=512, seq_len=33, batch_size=8)
+    ad = tad.AutoDistribute(
+        GPT2("test", vocab_size=512, max_seq_len=32),
+        optimizer=optax.adamw(1e-3),
+        loss_fn=next_token_loss,
+        strategy=strategy,
+        precision=precision,
+        devices=devices,
+        **kwargs,
+    )
+    state = ad.init(jax.random.key(0), data.batch(0))
+    losses = []
+    for i in range(steps):
+        state, m = ad.step(state, data.batch(i))
+        losses.append(float(m["loss"]))
+    return losses, state, ad
+
+
+def leaf_dtypes(tree):
+    return {str(x.dtype) for x in jax.tree.leaves(tree) if hasattr(x, "dtype")}
+
+
+def test_presets_resolve():
+    assert pmod.resolve("fp32").param_dtype == jnp.float32
+    assert pmod.resolve("mixed").moment_dtype == jnp.bfloat16
+    assert pmod.resolve(pmod.PRESETS["bf16"]).name == "bf16"
+    with pytest.raises(ValueError):
+        pmod.resolve("fp8")
+
+
+def test_bytes_per_param():
+    assert pmod.PRESETS["fp32"].bytes_per_param == 16
+    assert pmod.PRESETS["mixed"].bytes_per_param == 10
+    assert pmod.PRESETS["bf16"].bytes_per_param == 8
+
+
+def test_mixed_state_dtypes():
+    _, state, _ = run_steps("mixed", steps=1)
+    # master params stay fp32
+    pd = leaf_dtypes(state.params)
+    assert pd == {"float32"}, pd
+    # moment tensors are bf16; scalar counts remain integer
+    tensor_dtypes = {
+        str(x.dtype)
+        for x in jax.tree.leaves(state.opt_state)
+        if hasattr(x, "dtype") and x.ndim >= 1
+        and jnp.issubdtype(x.dtype, jnp.floating)
+    }
+    assert tensor_dtypes == {"bfloat16"}, tensor_dtypes
+
+
+def test_bf16_state_dtypes():
+    _, state, _ = run_steps("bf16", steps=1)
+    float_param_dtypes = {
+        str(x.dtype)
+        for x in jax.tree.leaves(state.params)
+        if jnp.issubdtype(x.dtype, jnp.floating)
+    }
+    assert float_param_dtypes == {"bfloat16"}, float_param_dtypes
+
+
+def test_mixed_parity_with_fp32():
+    l32, _, _ = run_steps("fp32", steps=4)
+    lmx, _, _ = run_steps("mixed", steps=4)
+    # bf16 compute everywhere except logits: losses track to ~1%
+    np.testing.assert_allclose(l32, lmx, rtol=2e-2)
+    assert lmx[-1] < lmx[0], "mixed-precision training is not learning"
+
+
+def test_bf16_trains():
+    lbf, _, _ = run_steps("bf16", steps=4)
+    assert lbf[-1] < lbf[0], "bf16 training is not learning"
+    assert all(l == l for l in lbf), "NaN loss under bf16"
+
+
+def test_mixed_under_fsdp(devices8):
+    l1, _, _ = run_steps("mixed", steps=3, strategy="dp",
+                         devices=[jax.devices()[0]])
+    l8, state, ad = run_steps("mixed", steps=3, strategy="fsdp")
+    assert tad.mesh_degrees(ad.plan.mesh)["fsdp"] == 8
+    np.testing.assert_allclose(l1, l8, rtol=2e-2)
+    # opt-state moment shardings inherit param specs (ZeRO) under bf16 too
+    mu_shardings = {
+        str(x.sharding.spec)
+        for x in jax.tree.leaves(state.opt_state)
+        if hasattr(x, "sharding") and x.ndim >= 1
+        and jnp.issubdtype(x.dtype, jnp.bfloat16)
+    }
+    assert any("fsdp" in s for s in mu_shardings), mu_shardings
+
+
+def test_wrap_optimizer_fp32_is_identity():
+    opt = optax.adamw(1e-3)
+    assert pmod.wrap_optimizer(opt, pmod.PRESETS["fp32"]) is opt
+
+
+def test_wrapped_update_math_in_fp32():
+    """bf16 moment storage must not collapse Adam's nu accumulation: a
+    gradient of 1e-3 gives nu ~1e-6 * (1-b2) — representable in bf16's
+    range, but the *update* math must run in fp32 (cast-up path)."""
+    prec = pmod.PRESETS["bf16"]
+    opt = pmod.wrap_optimizer(optax.adam(1e-2), prec)
+    params = {"w": jnp.ones((4, 4), jnp.bfloat16)}
+    grads = {"w": jnp.full((4, 4), 1e-3, jnp.bfloat16)}
+    state = opt.init(params)
+    mu_dtypes = {
+        str(x.dtype) for x in jax.tree.leaves(state)
+        if hasattr(x, "dtype") and x.ndim >= 1
+    }
+    assert mu_dtypes == {"bfloat16"}
+    updates, state = opt.update(grads, state, params)
+    new = optax.apply_updates(params, updates)
+    assert new["w"].dtype == jnp.bfloat16
+    # step of adam with constant grads moves params by ~lr toward -inf
+    assert float(new["w"][0, 0]) < 1.0
+
+
+def test_planner_accounts_for_precision():
+    """A model whose fp32 Adam state overflows the HBM budget but whose
+    mixed-precision state fits must resolve to dp under mixed."""
+    from torch_automatic_distributed_neural_network_tpu import planner
+
+    topo = tad.topology.detect()
+    hbm = planner._hbm_bytes(topo.device_kind)
+    # pick n so that 4x fp32 bytes > 0.6*hbm but mixed 2.5x fits
+    n_elems = int(0.6 * hbm / 4 / 2.8)
+    fake = {"up_proj": {"kernel": jax.ShapeDtypeStruct((n_elems,), jnp.float32)}}
+    topo8 = topo.__class__(**{**topo.__dict__, "num_devices": 8})
+    s_fp32, _ = planner.choose_strategy(fake, topo8, state_factor=4.0)
+    s_mixed, _ = planner.choose_strategy(fake, topo8, state_factor=2.5)
+    assert s_fp32 in ("fsdp", "tp_fsdp")
+    assert s_mixed == "dp"

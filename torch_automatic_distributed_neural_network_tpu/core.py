@@ -37,8 +37,11 @@ import optax
 from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+import numpy as np
+
 from . import planner as planner_mod
 from . import topology as topo_mod
+from .training import precision as precision_mod
 from .training.optim import opt_state_spec_tree
 
 
@@ -85,6 +88,11 @@ class AutoDistribute:
         planner decides (on for fsdp/tp_fsdp).
     donate:
         Donate the input state buffers to the step (halves peak HBM).
+    precision:
+        'fp32' (default) | 'mixed' (fp32 master params, bf16 compute/grads/
+        moments — 10 B/param) | 'bf16' (all-bf16 storage — 8 B/param), or a
+        ``training.precision.Precision``.  Update math is always fp32; the
+        planner's HBM model accounts for the chosen dtypes.
     """
 
     def __init__(
@@ -104,11 +112,15 @@ class AutoDistribute:
         seq_impl: str = "auto",
         pipeline_stages: int = 1,
         microbatches: int = 8,
+        precision: str | precision_mod.Precision = "fp32",
     ):
         if model is None and init_fn is None:
             raise ValueError("Provide a model or an init_fn")
         self.model = model
-        self.optimizer = optimizer or optax.adamw(1e-3)
+        self.precision = precision_mod.resolve(precision)
+        self.optimizer = precision_mod.wrap_optimizer(
+            optimizer or optax.adamw(1e-3), self.precision
+        )
         self._loss_fn = loss_fn
         self._init_fn = init_fn or (lambda rng, batch: _default_init(model, rng, batch))
         self._strategy = strategy
@@ -146,11 +158,24 @@ class AutoDistribute:
             return params, model_state
         return variables, {}
 
+    def _init_variables(self, rng: jax.Array, sample_batch: Any) -> Any:
+        """Run the user init and cast params to the precision's storage
+        dtype (model_state — batch stats etc. — stays fp32)."""
+        variables = self._init_fn(rng, sample_batch)
+        if np.dtype(self.precision.param_dtype) == np.dtype(jnp.float32):
+            return variables
+        params, model_state = self._split_variables(variables)
+        params = precision_mod.cast_floats(params, self.precision.param_dtype)
+        if isinstance(variables, dict) and "params" in variables:
+            return {"params": params, **model_state}
+        return params
+
     def build_plan(self, rng: jax.Array, sample_batch: Any) -> planner_mod.ShardPlan:
         """Trace the init to abstract shapes and run the partition planner."""
-        abstract_vars = jax.eval_shape(self._init_fn, rng, sample_batch)
+        abstract_vars = jax.eval_shape(self._init_variables, rng, sample_batch)
         abstract, abstract_ms = self._split_variables(abstract_vars)
         self._has_model_state = bool(jax.tree.leaves(abstract_ms))
+        prec = self.precision
         self.plan = planner_mod.make_plan(
             abstract,
             mesh=self._mesh,
@@ -160,11 +185,16 @@ class AutoDistribute:
             remat=self._remat,
             seq=self._seq_parallel,
             pipe=self._pipeline_stages,
+            state_factor=(
+                prec.bytes_per_param / np.dtype(prec.param_dtype).itemsize
+            ),
         )
         from .parallel import context as pctx
 
         self._pctx = pctx.ParallelContext(
-            mesh=self.plan.mesh, seq_impl=self._seq_impl
+            mesh=self.plan.mesh,
+            seq_impl=self._seq_impl,
+            enable_constraints=self._pipeline_stages == 1,
         )
         if self._pipeline_stages > 1:
             if self._has_model_state:
@@ -234,7 +264,7 @@ class AutoDistribute:
         def make_state(rng):
             init_rng, state_rng = jax.random.split(rng)
             params, model_state = self._split_variables(
-                self._init_fn(init_rng, sample_batch)
+                self._init_variables(init_rng, sample_batch)
             )
             opt_state = self.optimizer.init(params)
             return TrainState(
@@ -319,6 +349,11 @@ class AutoDistribute:
             with pctx.use(self._pctx):
                 return traced_step(state, batch)
 
+        prec = self.precision
+        cast_for_compute = np.dtype(prec.compute_dtype) != np.dtype(
+            prec.param_dtype
+        )
+
         def traced_step(state: TrainState, batch):
             step_rng = jax.random.fold_in(state.rng, state.step)
 
@@ -332,8 +367,17 @@ class AutoDistribute:
                     loss_inner,
                     policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
                 )
+            # Mixed precision: differentiate w.r.t. the compute-dtype cast
+            # of the master params, so the whole gradient tree materializes
+            # in compute_dtype (half the HBM of fp32 grads); the optimizer
+            # wrapper casts back up for fp32 update math.
+            compute_params = (
+                precision_mod.cast_floats(state.params, prec.compute_dtype)
+                if cast_for_compute
+                else state.params
+            )
             grad_fn = jax.value_and_grad(loss_inner, has_aux=True)
-            (loss, aux), grads = grad_fn(state.params)
+            (loss, aux), grads = grad_fn(compute_params)
             updates, opt_state = self.optimizer.update(
                 grads, state.opt_state, state.params
             )
@@ -357,8 +401,15 @@ class AutoDistribute:
         )
 
     def step(self, state: TrainState, batch) -> tuple[TrainState, dict]:
-        """One optimizer step.  Hot loop: dispatch-only after first compile."""
+        """One optimizer step.  Hot loop: dispatch-only after first compile.
+
+        Under multi-host, ``batch`` is this host's slice (shard_for_host /
+        a per-host loader) and is assembled into global arrays first; on
+        one host it goes straight to the jitted step.
+        """
         assert self._step_fn is not None, "call init() first"
+        if jax.process_count() > 1:
+            batch = self.shard_batch(batch)
         return self._step_fn(state, batch)
 
     # -- inference ----------------------------------------------------------
@@ -385,10 +436,36 @@ class AutoDistribute:
         return self._fwd(variables, *args, **kwargs)
 
     def shard_batch(self, batch):
-        """Place a host-local batch onto the mesh with the plan's sharding."""
+        """Place a batch onto the mesh with the plan's sharding.
+
+        One host: a plain sharded device_put (the input is the global
+        batch).  Multi-host (SURVEY.md C13): the input is this host's
+        row-slice (``data.shard_for_host``) and the global array is
+        assembled from every host's slice via
+        ``jax.make_array_from_process_local_data`` — the torchrun/
+        DistributedSampler analog.  Leaves that are already global
+        ``jax.Array``s pass through untouched.
+        """
         assert self.plan is not None
         sharding = self.plan.batch_sharding()
-        return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+        if jax.process_count() == 1:
+            return jax.tree.map(
+                lambda x: x if isinstance(x, jax.Array)
+                and x.sharding == sharding
+                else jax.device_put(x, sharding),
+                batch,
+            )
+
+        def to_global(x):
+            if isinstance(x, jax.Array) and not x.is_fully_replicated and (
+                x.sharding == sharding
+            ):
+                return x
+            return jax.make_array_from_process_local_data(
+                sharding, np.asarray(x)
+            )
+
+        return jax.tree.map(to_global, batch)
 
 
 def _model_input(batch):

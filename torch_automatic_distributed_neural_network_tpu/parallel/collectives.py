@@ -53,13 +53,16 @@ def reduce_scatter(x: jax.Array, axis: str, *, scatter_dim: int = 0) -> jax.Arra
 
 
 def broadcast(x: jax.Array, axis: str, root: int = 0) -> jax.Array:
-    """Every shard receives the root shard's value (NCCL broadcast analog)."""
-    idx = jax.lax.axis_index(axis)
-    n = jax.lax.axis_size(axis)
-    # send root's value around the ring: select root's contribution of an
-    # allreduce of the masked value
-    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
-    return jax.lax.psum(masked, axis)
+    """Every shard receives the root shard's value (NCCL broadcast analog).
+
+    Implemented as all_gather + root slice: (n-1)/n bytes per rank on the
+    wire — half the cost of the masked-psum formulation (an allreduce at
+    2(n-1)/n), and the gather of non-root shards is dead weight the ring
+    schedule absorbs.  Suitable for weight-sized payloads, not just
+    scalars; transient memory is n * shard bytes.
+    """
+    g = jax.lax.all_gather(x, axis)  # [n, ...]
+    return g[root]
 
 
 def all_to_all(
@@ -206,3 +209,126 @@ def bench_sweep(
     **kwargs,
 ) -> list[CollectiveBenchResult]:
     return [bench_collective(op, s, **kwargs) for op in ops for s in sizes]
+
+
+# ---------------------------------------------------------------------------
+# Comm/compute overlap microbenchmark (component C4)
+# ---------------------------------------------------------------------------
+#
+# The reference's bucketed DDP overlaps gradient allreduce with the rest of
+# the backward pass (BASELINE.json:9).  The TPU-native analog delegates that
+# scheduling to XLA's latency-hiding scheduler — this benchmark MEASURES
+# whether the overlap actually happens instead of asserting it: a chain of
+# L matmul "layers" each releasing a psum "bucket" that only depends on its
+# own layer (the DDP dependency shape), timed against compute-only and
+# comm-only baselines.
+#
+#   overlap_frac = (t_compute + t_comm - t_both) / min(t_compute, t_comm)
+#
+# 1.0 = the cheaper phase fully hidden; 0.0 = fully serialized.
+#
+# Recommended TPU flags (set in XLA_FLAGS before process start; they steer
+# the scheduler, they do not change semantics):
+#   --xla_tpu_enable_latency_hiding_scheduler=true
+
+LATENCY_HIDING_XLA_FLAGS = "--xla_tpu_enable_latency_hiding_scheduler=true"
+
+
+@dataclasses.dataclass
+class OverlapBenchResult:
+    n_devices: int
+    layers: int
+    t_compute_s: float
+    t_comm_s: float
+    t_both_s: float
+    overlap_frac: float
+    bucket_bytes: int
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def bench_overlap(
+    *,
+    mesh: Mesh | None = None,
+    axis: str = "data",
+    d: int = 512,
+    layers: int = 8,
+    bucket_bytes: int = 2**22,
+    iters: int = 5,
+    warmup: int = 2,
+) -> OverlapBenchResult:
+    """Measure how much gradient-bucket psum the scheduler hides behind
+    the matmul chain (the bucketed-DDP shape, component C4)."""
+    if mesh is None:
+        from .. import topology
+
+        mesh = topology.build_mesh(data=-1)
+    n = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
+    m = max(bucket_bytes // 4, 128)
+    key = jax.random.key(0)
+    w = jax.random.normal(key, (d, d), jnp.float32) / np.sqrt(d)
+    x0 = jax.random.normal(key, (d, d), jnp.float32)
+    buf = jnp.ones((m,), jnp.float32)
+
+    def layer(y):
+        return jax.lax.dot(y, w, precision=jax.lax.Precision.DEFAULT)
+
+    def compute_only(y, _buf):
+        acc = jnp.float32(0)
+        for _ in range(layers):
+            y = layer(y)
+            acc = acc + y[0, 0]
+        return acc
+
+    def comm_only(y, b):
+        acc = jnp.float32(0)
+        for i in range(layers):
+            # per-bucket payload differs (defeats CSE); no matmul feeds it
+            g = jax.lax.psum(b + jnp.float32(i), axis)
+            acc = acc + g[0]
+        return acc
+
+    def both(y, b):
+        acc = jnp.float32(0)
+        for _ in range(layers):
+            y = layer(y)
+            # DDP shape: bucket i depends on layer i only — the scheduler
+            # may overlap its psum with layer i+1's matmul
+            g = jax.lax.psum(b + y[0, 0], axis)
+            acc = acc + g[0]
+        return acc
+
+    def timed(fn):
+        smapped = shard_map(
+            fn, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+            check_vma=False,
+        )
+
+        @jax.jit
+        def run_n(x, b):
+            def body(i, carry):
+                out = smapped(x + (carry * 0), b)
+                return carry + out
+            return jax.lax.fori_loop(0, iters, body, jnp.float32(0))
+
+        for _ in range(warmup):
+            float(run_n(x0, buf))
+        t0 = time.perf_counter()
+        total = float(run_n(x0, buf))
+        assert total == total
+        return max(time.perf_counter() - t0, 1e-9) / iters
+
+    tc = timed(compute_only)
+    tm = timed(comm_only)
+    tb = timed(both)
+    frac = (tc + tm - tb) / max(min(tc, tm), 1e-9)
+    return OverlapBenchResult(
+        n_devices=n,
+        layers=layers,
+        t_compute_s=tc,
+        t_comm_s=tm,
+        t_both_s=tb,
+        overlap_frac=max(min(frac, 1.0), -1.0),
+        bucket_bytes=m * 4,
+    )

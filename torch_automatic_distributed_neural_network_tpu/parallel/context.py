@@ -25,6 +25,15 @@ class ParallelContext:
     seq_axis: str = "seq"
     head_axis: str = "tensor"
     seq_impl: str = "auto"  # 'auto' | 'ring' | 'ulysses'
+    # Megatron-SP (SURVEY.md §2.2 SP row): outside the matmul blocks the
+    # residual stream's *sequence* dim also shards over the tensor axis, so
+    # norms/dropout/residual memory and compute scale with TP; GSPMD turns
+    # the boundary transitions into all_gather / reduce_scatter pairs (the
+    # g / g-bar operators of the Megatron-SP paper).
+    megatron_sp: bool = True
+    # False when the model body runs inside a shard_map region (pipeline
+    # stages): mesh-axis sharding constraints are meaningless per-shard.
+    enable_constraints: bool = True
 
     @property
     def degrees(self) -> dict[str, int]:
@@ -44,11 +53,25 @@ class ParallelContext:
         axes = self.present_batch_axes
         return axes if axes else None
 
+    def seq_spec_entry(self, *, seq_sharded: bool = True):
+        """Mesh axes the sequence dim shards over: the context-parallel
+        ``seq`` axis and, under Megatron-SP, the ``tensor`` axis."""
+        if not seq_sharded:
+            return None
+        axes = []
+        if self.seq_degree > 1:
+            axes.append(self.seq_axis)
+        if self.megatron_sp and self.degrees.get(self.head_axis, 1) > 1:
+            axes.append(self.head_axis)
+        if not axes:
+            return None
+        return axes[0] if len(axes) == 1 else tuple(axes)
+
     def activation_spec(self, *, seq_sharded: bool = True) -> P:
         """[batch, seq, hidden...] activation sharding under this context."""
         return P(
             self.batch_spec_entry(),
-            self.seq_axis if seq_sharded and self.seq_degree > 1 else None,
+            self.seq_spec_entry(seq_sharded=seq_sharded),
         )
 
 
@@ -71,15 +94,21 @@ def use(ctx: ParallelContext | None):
 
 
 def shard_activations(x: jax.Array, *, seq_sharded: bool = True) -> jax.Array:
-    """Megatron-SP style activation sharding constraint: no-op without an
-    active context or a trivial mesh."""
+    """Megatron-SP / CP activation sharding constraint on a [batch, seq,
+    ...] tensor: no-op without an active context or a trivial mesh.
+
+    Models call this at residual-stream boundaries (transformer_core.py);
+    under TP the sequence dim shards over the tensor axis so that GSPMD
+    lowers the block entries/exits to all_gather + reduce_scatter instead
+    of keeping full activations everywhere (Megatron-SP), and under CP the
+    sequence dim stays pinned to the ``seq`` axis between attention calls.
+    """
     ctx = current()
-    if ctx is None:
-        return x
-    d = ctx.degrees
-    if all(d.get(a, 1) == 1 for a in (*ctx.batch_axes, ctx.seq_axis)):
+    if ctx is None or not ctx.enable_constraints:
         return x
     spec = ctx.activation_spec(seq_sharded=seq_sharded)
+    if all(entry is None for entry in spec):
+        return x
     ndim_pad = x.ndim - len(spec)
     full = P(*spec, *([None] * ndim_pad))
     return jax.lax.with_sharding_constraint(

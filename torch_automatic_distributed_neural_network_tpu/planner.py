@@ -333,6 +333,7 @@ def choose_strategy(
     abstract_params: Any,
     topo: topo_mod.Topology,
     rules: Sequence[Rule] = TRANSFORMER_RULES,
+    state_factor: float = 4.0,
 ) -> tuple[str, dict[str, int]]:
     """Auto policy: pick (strategy, mesh axis degrees) from model size vs
     HBM and whether TP rules apply to this model's parameter names.
@@ -351,7 +352,11 @@ def choose_strategy(
     if n == 1:
         return "dp", {"data": 1}
     pbytes = tree_bytes(abstract_params)
-    train_state_bytes = 4 * pbytes  # params + grads + 2 adam moments
+    # params + grads + 2 adam moments; state_factor scales param bytes to
+    # full train-state bytes (4.0 for uniform fp32; training/precision.py
+    # supplies the mixed-precision value, e.g. 2.5 for fp32 master + bf16
+    # grads/moments)
+    train_state_bytes = state_factor * pbytes
     e_count = detect_expert_count(abstract_params)
     if e_count:
         # MoE model: put the expert dim on its own axis so dispatch rides
@@ -367,7 +372,7 @@ def choose_strategy(
                 for _, leaf in _expert_banks(abstract_params)
             )
             dense_b = pbytes - expert_b
-            per_device = 4 * (dense_b + expert_b / e)
+            per_device = state_factor * (dense_b + expert_b / e)
             if per_device < 0.6 * _hbm_bytes(topo.device_kind):
                 return "ep", {"expert": e, "data": rest}
             # Memory-tight: the fsdp axis must be real (>=2) or dense
@@ -410,6 +415,7 @@ def make_plan(
     remat: bool | None = None,
     seq: int = 1,
     pipe: int = 1,
+    state_factor: float = 4.0,
 ) -> ShardPlan:
     """The planner: abstract params + topology -> ShardPlan.
 
@@ -449,7 +455,7 @@ def make_plan(
         if strategy == "auto":
             resolved, degrees = choose_strategy(
                 abstract_params, dataclasses.replace(topo, num_devices=n),
-                rules,
+                rules, state_factor=state_factor,
             )
             if pipe > 1 and resolved in ("tp", "tp_fsdp", "ep", "ep_fsdp"):
                 # v1: pp composes with dp/fsdp only
@@ -555,7 +561,7 @@ def make_plan(
                 pb = (pb - eb) + eb // e_deg
             pb //= max(1, degrees_final.get("tensor", 1))
             pb //= max(1, degrees_final.get("pipe", 1))
-            remat = 4 * pb > 0.5 * _hbm_bytes(topo.device_kind)
+            remat = state_factor * pb > 0.5 * _hbm_bytes(topo.device_kind)
     return ShardPlan(
         mesh=mesh,
         strategy=resolved,

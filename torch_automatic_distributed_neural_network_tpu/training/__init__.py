@@ -15,6 +15,7 @@ from .losses import (
     softmax_xent_loss_mutable,
 )
 from .metrics import MetricsLogger, peak_flops_per_chip, transformer_step_flops
+from .precision import Precision, resolve as resolve_precision
 
 _LAZY = {
     "CheckpointManager": "checkpoint",
@@ -39,6 +40,8 @@ __all__ = [
     "MetricsLogger",
     "peak_flops_per_chip",
     "transformer_step_flops",
+    "Precision",
+    "resolve_precision",
     *_LAZY,
 ]
 

@@ -1,0 +1,140 @@
+"""Mixed-precision train-state policies (SURVEY.md C14 / BASELINE.json:10).
+
+The reference trains in fp32 (stock torch.optim on CUDA; its mixed-precision
+analog is torch.cuda.amp + apex master weights).  TPU-native: the MXU is
+bfloat16-first, so compute is bf16 by default already (models set
+``dtype=bfloat16`` with fp32 params).  What this module adds is control over
+the *train state* dtypes — parameter storage, gradient, and optimizer-moment
+dtypes — which dominate HBM: fp32 Adam state is 16 bytes/param, which puts a
+1.3B-param model (21 GB) out of reach of a 16 GB v5e chip.  Presets:
+
+- ``fp32``   params fp32, grads fp32, moments fp32 (16 B/param incl. grads)
+- ``mixed``  params fp32 (master), compute+grads bf16, moments bf16
+             (10 B/param): the apex-O2 analog — update math stays fp32
+- ``bf16``   everything stored bf16 (8 B/param): max headroom; update math
+             is still performed in fp32 (moments are cast up, updated, cast
+             back) so the Adam second moment does not collapse
+
+The optimizer wrapper stores moments in ``moment_dtype`` but always runs the
+inner transform in fp32: casting bf16 -> fp32 -> update -> bf16 loses only
+storage precision, never accumulation precision within a step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+@dataclasses.dataclass(frozen=True)
+class Precision:
+    """Dtype policy for the train state.
+
+    ``param_dtype``   storage dtype of trained parameters.
+    ``compute_dtype`` dtype params are cast to at the loss boundary; the
+                      gradient tree comes back in this dtype.
+    ``moment_dtype``  storage dtype of optimizer-state tensors (Adam mu/nu,
+                      SGD momentum) — anything param-shaped in the state.
+    """
+
+    name: str
+    param_dtype: Any
+    compute_dtype: Any
+    moment_dtype: Any
+
+    @property
+    def bytes_per_param(self) -> float:
+        """Persistent+transient train-state bytes per parameter under Adam:
+        params + grads + two moments (the planner's HBM model)."""
+        return (
+            np.dtype(self.param_dtype).itemsize
+            + np.dtype(self.compute_dtype).itemsize
+            + 2 * np.dtype(self.moment_dtype).itemsize
+        )
+
+
+PRESETS: dict[str, Precision] = {
+    "fp32": Precision("fp32", jnp.float32, jnp.float32, jnp.float32),
+    "mixed": Precision("mixed", jnp.float32, jnp.bfloat16, jnp.bfloat16),
+    "bf16": Precision("bf16", jnp.bfloat16, jnp.bfloat16, jnp.bfloat16),
+}
+
+
+def resolve(precision: str | Precision) -> Precision:
+    if isinstance(precision, Precision):
+        return precision
+    try:
+        return PRESETS[precision]
+    except KeyError:
+        raise ValueError(
+            f"Unknown precision {precision!r}; expected one of "
+            f"{sorted(PRESETS)} or a Precision instance"
+        ) from None
+
+
+def cast_floats(tree: Any, dtype: Any) -> Any:
+    """Cast floating-point array leaves of a pytree to ``dtype``.
+
+    Integer leaves (token tables, step counters) and python scalars pass
+    through untouched.
+    """
+
+    def cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree.map(cast, tree)
+
+
+def _cast_state_tensors(state: Any, dtype: Any) -> Any:
+    """Cast float *tensor* leaves (ndim >= 1) of an optimizer state.
+
+    Scalars (step counts, schedule accumulators) keep their dtype — they
+    are tiny and some (e.g. fp32 loss scales) must stay high precision.
+    """
+
+    def cast(x):
+        if (
+            hasattr(x, "dtype")
+            and getattr(x, "ndim", 0) >= 1
+            and jnp.issubdtype(x.dtype, jnp.floating)
+        ):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree.map(cast, state)
+
+
+def wrap_optimizer(
+    inner: optax.GradientTransformation, precision: Precision
+) -> optax.GradientTransformation:
+    """Store optimizer state in ``moment_dtype``; run update math in fp32.
+
+    Gradients and params are cast up to fp32 before the inner transform so
+    Adam's moment accumulation and the weight-decay term never happen in
+    bf16; the returned updates are fp32 (``optax.apply_updates`` casts them
+    onto the param dtype).
+    """
+    if np.dtype(precision.moment_dtype) == np.dtype(jnp.float32) and (
+        np.dtype(precision.param_dtype) == np.dtype(jnp.float32)
+    ):
+        return inner
+
+    def init_fn(params):
+        state = inner.init(cast_floats(params, jnp.float32))
+        return _cast_state_tensors(state, precision.moment_dtype)
+
+    def update_fn(updates, state, params=None):
+        state32 = _cast_state_tensors(state, jnp.float32)
+        grads32 = cast_floats(updates, jnp.float32)
+        params32 = cast_floats(params, jnp.float32) if params is not None else None
+        out, new_state = inner.update(grads32, state32, params32)
+        return out, _cast_state_tensors(new_state, precision.moment_dtype)
+
+    return optax.GradientTransformation(init_fn, update_fn)
