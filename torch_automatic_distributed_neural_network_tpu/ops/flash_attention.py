@@ -270,12 +270,30 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd(cfg: _Cfg, res, do):
+    return _bwd_impl(cfg, res, do, None)
+
+
+def _bwd_stats(cfg: _Cfg, res, cot):
+    """VJP for the (o, lse)-returning forward.  The lse cotangent folds
+    into the delta term: dL/ds = p*(dp - delta) + p*dlse = p*(dp -
+    (delta - dlse)), so the kernels run unchanged with an adjusted delta.
+    """
+    do, dlse_full = cot
+    # dlse arrives in the lane-broadcast layout; callers slice one lane,
+    # so summing over lanes recovers the row cotangent.
+    dlse = jnp.sum(dlse_full.astype(jnp.float32), axis=-1)
+    return _bwd_impl(cfg, res, do, dlse)
+
+
+def _bwd_impl(cfg: _Cfg, res, do, dlse):
     q, k, v, o, lse = res
     bh, sq, d = q.shape
     sk = k.shape[1]
     nq, nk = sq // cfg.block_q, sk // cfg.block_k
     scale = 1.0 / float(np.sqrt(d))
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    if dlse is not None:
+        delta = delta - dlse
     delta = jnp.broadcast_to(delta[..., None], (*delta.shape, _LANES))
 
     q_spec = pl.BlockSpec((1, cfg.block_q, d), lambda b, i, j: (b, i, 0))
@@ -338,6 +356,21 @@ def _flash_core_fwd(q, k, v, cfg: _Cfg):
 _flash_core.defvjp(_flash_core_fwd, _bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash_core_stats(q, k, v, cfg: _Cfg):
+    """Like _flash_core but also returns the lane-broadcast logsumexp —
+    the merge statistic ring attention needs (parallel/ring.py)."""
+    return _fwd(q, k, v, cfg)
+
+
+def _flash_core_stats_fwd(q, k, v, cfg: _Cfg):
+    o, lse = _fwd(q, k, v, cfg)
+    return (o, lse), (q, k, v, o, lse)
+
+
+_flash_core_stats.defvjp(_flash_core_stats_fwd, _bwd_stats)
+
+
 # ---------------------------------------------------------------------------
 # Public BSHD entry point
 # ---------------------------------------------------------------------------
@@ -352,26 +385,9 @@ def _pad_to(x, target, dim):
     return jnp.pad(x, widths)
 
 
-def flash_attention(
-    q: jax.Array,
-    k: jax.Array,
-    v: jax.Array,
-    *,
-    causal: bool = False,
-    block_q: int = 1024,
-    block_k: int = 1024,
-    interpret: bool | None = None,
-) -> jax.Array:
-    """Flash attention over BSHD tensors [batch, seq, heads, head_dim].
-
-    Numerically matches :func:`..attention.xla_attention` (the oracle the
-    tests compare against) while never materializing the [S, S] score
-    matrix.  K/V may have fewer heads (GQA) — broadcast to Q's head count.
-
-    Block defaults were tuned on a live v5e: 1024x1024 runs the fwd+bwd
-    step ~5x faster than XLA's einsum attention at seq 2048 (d=64);
-    2048-wide q blocks exceed VMEM and fail to compile.
-    """
+def _prep_bshd(q, k, v, causal, block_q, block_k, interpret):
+    """Shared BSHD preprocessing: GQA broadcast, fold to [B*H, S, D], pad
+    to block multiples.  Returns (qf, kf, vf, cfg, (b, hq, sq, d))."""
     if interpret is None:
         interpret = _default_interpret()
     b, sq, hq, d = q.shape
@@ -399,7 +415,61 @@ def flash_attention(
     qf = _pad_to(fold(q), sq_pad, 1)
     kf = _pad_to(fold(k), sk_pad, 1)
     vf = _pad_to(fold(v), sk_pad, 1)
+    return qf, kf, vf, cfg, (b, hq, sq, d)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    block_q: int = 1024,
+    block_k: int = 1024,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Flash attention over BSHD tensors [batch, seq, heads, head_dim].
+
+    Numerically matches :func:`..attention.xla_attention` (the oracle the
+    tests compare against) while never materializing the [S, S] score
+    matrix.  K/V may have fewer heads (GQA) — broadcast to Q's head count.
+
+    Block defaults were tuned on a live v5e: 1024x1024 runs the fwd+bwd
+    step ~5x faster than XLA's einsum attention at seq 2048 (d=64);
+    2048-wide q blocks exceed VMEM and fail to compile.
+    """
+    qf, kf, vf, cfg, (b, hq, sq, d) = _prep_bshd(
+        q, k, v, causal, block_q, block_k, interpret
+    )
     of = _flash_core(qf, kf, vf, cfg)
     of = of[:, :sq]
     o = of.reshape(b, hq, sq, d)
     return jnp.swapaxes(o, 1, 2)
+
+
+def flash_attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    block_q: int = 1024,
+    block_k: int = 1024,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Flash attention returning ``(o, lse)`` — ``o`` as BSHD, ``lse``
+    [batch, heads, seq] fp32 logsumexp of each row's scores.
+
+    The lse output is what makes per-block results mergeable: ring
+    attention (parallel/ring.py) combines normalized block outputs as
+    ``sum_i o_i * exp(lse_i - logaddexp_i(lse_i))``.  Gradients flow
+    through both outputs (the lse cotangent folds into the kernels'
+    delta term).
+    """
+    qf, kf, vf, cfg, (b, hq, sq, d) = _prep_bshd(
+        q, k, v, causal, block_q, block_k, interpret
+    )
+    of, lse_f = _flash_core_stats(qf, kf, vf, cfg)
+    o = jnp.swapaxes(of[:, :sq].reshape(b, hq, sq, d), 1, 2)
+    lse = lse_f[:, :sq, 0].reshape(b, hq, sq)
+    return o, lse

@@ -3,9 +3,24 @@
 
 Each device holds one sequence block of Q and one of K/V.  K/V blocks
 rotate around the ICI ring via ``ppermute`` while every device folds each
-visiting block into its local attention accumulator with the online-softmax
-(flash) recurrence — so attention over a sequence of length S costs
-O(S/cp) memory per chip and the ring hop overlaps with the block matmuls.
+visiting block into its local accumulator.  The block-local attention is
+the first-party Pallas flash kernel (ops/flash_attention.py) — the two
+fast paths compose: the kernel returns ``(o, lse)`` per block and blocks
+merge by logsumexp weights, so attention over a sequence of length S
+costs O(S/cp) memory per chip and the score matrix is never materialized
+in either direction (the kernel's custom VJP handles the block backward).
+
+Causal block dispatch (lax.switch per ring step):
+
+- block from an earlier ring position -> full (unmasked) kernel;
+- the device's own block          -> causal kernel (triangular);
+- block from a later position     -> skipped entirely (zero weight) —
+  no FLOPs spent on fully-masked blocks, unlike a masked einsum.
+
+Scheduling note: the fori_loop body computes on the resident block and
+then rotates; whether the ppermute hop actually overlaps the next block's
+compute is the compiler's latency-hiding decision, NOT a property this
+code enforces — measured, not assumed (bench.py mode=overlap).
 
 This module is the *explicit-collective* tier: it must be called inside a
 ``shard_map`` region where q/k/v are sharded along ``axis_name``.  The
@@ -16,44 +31,26 @@ shard_map using the ambient ParallelContext.
 from __future__ import annotations
 
 import functools
-from typing import Callable
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
+from ..ops.flash_attention import flash_attention_with_lse
+
 _NEG_BIG = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
-def _block_attn(q, k, v, bias):
-    """One flash block: returns (unnormalized_out, row_max, row_sum).
+def _merge_norm(o, lse, o2, lse2):
+    """Merge two *normalized* partial attentions by logsumexp weight.
 
-    q: [B, Sq, H, D]; k,v: [B, Sk, H, D]; bias: [B, 1|H, Sq, Sk] or None.
-    All accumulation in fp32.
+    o, o2: [B, S, H, D] fp32; lse, lse2: [B, H, S] fp32.
     """
-    d = q.shape[-1]
-    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    if bias is not None:
-        s = s + bias
-    m = jnp.max(s, axis=-1)  # [B, H, Sq]
-    # guard fully-masked rows: exp(-big - (-big)) would be exp(0)=1
-    m_safe = jnp.maximum(m, _NEG_BIG / 2)
-    p = jnp.exp(s - m_safe[..., None])  # [B, H, Sq, Sk]
-    l = jnp.sum(p, axis=-1)  # [B, H, Sq]
-    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
-    return o, m_safe, l
-
-
-def _merge(o, m, l, o2, m2, l2):
-    """Merge two online-softmax partial results."""
-    m_new = jnp.maximum(m, m2)
-    a = jnp.exp(m - m_new)
-    b = jnp.exp(m2 - m_new)
-    l_new = l * a + l2 * b
-    o_new = o * a.transpose(0, 2, 1)[..., None] + o2 * b.transpose(0, 2, 1)[..., None]
-    return o_new, m_new, l_new
+    lse_new = jnp.logaddexp(lse, lse2)
+    w = jnp.exp(lse - lse_new).transpose(0, 2, 1)[..., None]
+    w2 = jnp.exp(lse2 - lse_new).transpose(0, 2, 1)[..., None]
+    return o * w + o2 * w2, lse_new
 
 
 def ring_attention(
@@ -63,48 +60,61 @@ def ring_attention(
     *,
     causal: bool = True,
     axis_name: str = "seq",
+    block_q: int = 1024,
+    block_k: int = 1024,
+    interpret: bool | None = None,
 ) -> jax.Array:
     """Block-ring attention; call inside shard_map with q/k/v sharded on
     the sequence dim over ``axis_name``.  Shapes [B, S_local, H|Hkv, D].
 
-    GQA: fewer k/v heads than q heads are broadcast before the ring so the
-    recurrence stays head-aligned.
+    GQA: K/V rotate around the ring at their *small* head count (ICI
+    traffic scales with Hkv, not H); the flash kernel broadcasts heads
+    per block.
     """
     cp = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     b, sl, hq, dh = q.shape
-    hk = k.shape[2]
-    if hk != hq:
-        rep = hq // hk
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
 
-    q_pos = my * sl + jnp.arange(sl)  # global positions of local queries
+    flash = functools.partial(
+        flash_attention_with_lse,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+
+    def full_block(q, kb, vb):
+        return flash(q, kb, vb, causal=False)
+
+    def diag_block(q, kb, vb):
+        return flash(q, kb, vb, causal=True)
+
+    def skip_block(q, kb, vb):
+        return (
+            jnp.zeros((b, sl, hq, dh), q.dtype),
+            jnp.full((b, hq, sl), _NEG_BIG, jnp.float32),
+        )
 
     def body(step, carry):
-        o, m, l, kb, vb = carry
+        o, lse, kb, vb = carry
         # block kb originated on device (my - step) % cp
         origin = (my - step) % cp
-        kv_pos = origin * sl + jnp.arange(sl)
         if causal:
-            mask = q_pos[:, None] >= kv_pos[None, :]  # [Sq, Sk]
-            bias = jnp.where(mask, 0.0, _NEG_BIG)[None, None]
+            # earlier block -> full; own block -> triangular; later ->
+            # skip (whole-block causal skipping across the ring)
+            case = jnp.where(origin == my, 0, jnp.where(origin < my, 1, 2))
+            o2, lse2 = jax.lax.switch(
+                case, (diag_block, full_block, skip_block), q, kb, vb
+            )
         else:
-            bias = None
-        o2, m2, l2 = _block_attn(q, kb, vb, bias)
-        o, m, l = _merge(o, m, l, o2, m2, l2)
+            o2, lse2 = full_block(q, kb, vb)
+        o, lse = _merge_norm(o, lse, o2.astype(jnp.float32), lse2)
         # rotate kv to the next device (uniform across the ring every step;
         # the final hop restores the original placement)
         kb, vb = _rotate((kb, vb), axis_name)
-        return o, m, l, kb, vb
+        return o, lse, kb, vb
 
     o0 = jnp.zeros((b, sl, hq, dh), jnp.float32)
-    m0 = jnp.full((b, hq, sl), _NEG_BIG, jnp.float32)
-    l0 = jnp.zeros((b, hq, sl), jnp.float32)
-    o, m, l, _, _ = jax.lax.fori_loop(0, cp, body, (o0, m0, l0, k, v))
-    l = jnp.maximum(l, 1e-30)
-    out = o / l.transpose(0, 2, 1)[..., None]
-    return out.astype(q.dtype)
+    lse0 = jnp.full((b, hq, sl), _NEG_BIG, jnp.float32)
+    o, _, _, _ = jax.lax.fori_loop(0, cp, body, (o0, lse0, k, v))
+    return o.astype(q.dtype)
 
 
 def _rotate(kv, axis_name):
