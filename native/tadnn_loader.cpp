@@ -224,6 +224,16 @@ int tadnn_loader_batch(void* handle, int64_t step, uint32_t* out) {
     int slot = static_cast<int>(step % L->depth);
     bool served = false;
     if (L->ring_step[slot].load(std::memory_order_acquire) == step) {
+      // Seqlock-pattern read: the memcpy races the worker's fill() when
+      // the worker laps the ring between our two ring_step loads.  The
+      // plain (non-atomic) copy of racing memory is formally UB in the
+      // C++ memory model; it is the standard seqlock trade-off, accepted
+      // deliberately here because (a) the re-check below discards any
+      // torn copy before it is observable, (b) the data is plain
+      // uint32 with no invariants a torn read could violate mid-copy,
+      // and (c) copying through per-word relaxed atomics would forfeit
+      // the vectorized memcpy on the hot path.  The acquire fence orders
+      // the copy before the confirming load (the "version re-check").
       std::memcpy(out, L->ring[slot].data(),
                   L->ring[slot].size() * sizeof(uint32_t));
       std::atomic_thread_fence(std::memory_order_acquire);

@@ -128,3 +128,21 @@ def test_trains_with_autodistribute(devices8, token_file):
     state = trainer.fit(data)
     assert int(state.step) == 5
     data.close()
+
+
+def test_token_ids_over_int31_rejected(tmp_path):
+    """uint32 ids >= 2^31 would wrap negative through the int32 batch
+    buffers — write_token_file must refuse them (ADVICE r1)."""
+    import numpy as np
+    import pytest
+
+    path = str(tmp_path / "big.tadn")
+    with pytest.raises(ValueError, match="2\\*\\*31"):
+        write_token_file(path, np.array([1, 2, 2**31], dtype=np.uint32))
+    # just-under-the-limit ids round-trip fine
+    ok = np.arange(2**31 - 40, 2**31 - 1, dtype=np.uint32)
+    write_token_file(path, np.concatenate([ok, ok]))
+    ds = TokenFileDataset(path, seq_len=8, batch_size=2, backend="numpy")
+    batch = ds.batch(0)
+    assert batch["input_ids"].min() >= 0
+    ds.close()

@@ -55,6 +55,13 @@ def write_token_file(path: str, tokens: np.ndarray) -> None:
     tokens = np.asarray(tokens).ravel()
     if tokens.size and tokens.min() < 0:
         raise ValueError("tokens must be non-negative")
+    if tokens.size and int(tokens.max()) >= 2**31:
+        # batch() hands out int32 buffers (TPU-native token dtype); a
+        # uint32 id >= 2^31 would silently wrap negative on read.
+        raise ValueError(
+            f"token id {int(tokens.max())} >= 2**31 cannot round-trip "
+            "through the loader's int32 batches"
+        )
     dtype = np.uint16 if (tokens.size == 0 or tokens.max() < 2**16) else np.uint32
     header = np.zeros((), _HEADER)
     header["magic"] = _MAGIC
@@ -216,6 +223,14 @@ class TokenFileDataset:
             for r in range(self.batch_size):
                 start = self._window_start(step * self.batch_size + r)
                 out[r] = self._tokens[start:start + width]
+        if self._dtype is np.uint32 and out.min() < 0:
+            # a uint32 id >= 2^31 wrapped negative through the int32 view
+            # (file written by a foreign tool — write_token_file rejects
+            # such ids at write time)
+            raise ValueError(
+                f"{self.path}: token id >= 2**31 at step {step} does not "
+                "fit the loader's int32 batches"
+            )
         return {"input_ids": out}
 
     def __iter__(self):

@@ -127,11 +127,31 @@ class Heartbeat:
 
     @staticmethod
     def stale_hosts(directory: str, *, max_age_s: float) -> list[int]:
-        now = time.time()
-        return sorted(
-            h for h, b in Heartbeat.read_all(directory).items()
-            if now - b["time"] > max_age_s
-        )
+        """Hosts whose last beat is older than ``max_age_s``.
+
+        Beats carry the writer's wall clock, so staleness needs a
+        reference clock that survives skew.  A host is reported stale only
+        if it is stale against BOTH the local clock and the newest beat in
+        the directory: a local clock running ahead flags everyone against
+        the local reference but not against the newest peer beat, and one
+        peer with a fast (or corrupt future-stamped) clock flags everyone
+        against the peer reference but not against the local clock — a
+        single bad clock, wherever it lives, cannot poison detection.
+        Beats still assume roughly NTP-grade sync; size ``max_age_s``
+        (several beat intervals) to absorb residual skew.
+        """
+        beats = Heartbeat.read_all(directory)
+        ref_local = time.time()
+
+        def is_stale(h: int, b: dict) -> bool:
+            if ref_local - b["time"] <= max_age_s:
+                return False
+            # peer reference excludes the candidate's own beat, so a dead
+            # host alone in the directory is still detectable
+            others = [p["time"] for hh, p in beats.items() if hh != h]
+            return not others or max(others) - b["time"] > max_age_s
+
+        return sorted(h for h, b in beats.items() if is_stale(h, b))
 
 
 class StepWatchdog:
@@ -192,7 +212,11 @@ def run_with_recovery(
     fit: Callable[[], Any],
     *,
     max_restarts: int = 2,
-    retriable: tuple[type[BaseException], ...] = (Exception,),
+    retriable: tuple[type[BaseException], ...] = (
+        RuntimeError,  # wedged runtime / hung collective / InjectedFault
+        OSError,       # lost shared storage, dropped connections
+        TimeoutError,
+    ),
     on_restart: Callable[[int, BaseException], None] | None = None,
 ) -> Any:
     """Invoke ``fit`` and restart it after retriable failures.
@@ -201,6 +225,14 @@ def run_with_recovery(
     CheckpointManager, which restores the latest checkpoint on re-entry
     (restore_or_init).  Elastic resume onto a different mesh works because
     restore takes the *target* shardings (checkpoint.py docstring).
+
+    The default ``retriable`` set covers infrastructure-style failures
+    only: deterministic errors — the trainer's NaN guard
+    (FloatingPointError), shape/value errors — would replay identical
+    batches to an identical failure under step-indexed data, wasting
+    ``max_restarts`` compile+restore cycles.  Widen explicitly (e.g.
+    ``retriable=(Exception,)``) if your data source is nondeterministic
+    and a retry can genuinely change the outcome.
     """
     attempt = 0
     while True:

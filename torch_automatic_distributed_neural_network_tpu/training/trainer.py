@@ -117,17 +117,25 @@ class Trainer:
                     batch = first
                 else:
                     batch = data.batch(start)
+            pending_metrics = None
             for i in range(start, cfg.steps):
                 state, step_metrics = self.ad.step(state, batch)
                 if i + 1 < cfg.steps:
                     batch = data.batch(i + 1) if indexed else next(data_iter)
                 if cfg.watchdog_timeout_s:
-                    # beat on step *completion*, not dispatch — a hung
-                    # collective must stop the beats (elastic.py)
-                    jax.block_until_ready(step_metrics)
-                    if watchdog is None:
-                        watchdog = StepWatchdog(cfg.watchdog_timeout_s).start()
-                    watchdog.beat()
+                    # Beat on step *completion*, not dispatch — a hung
+                    # collective must stop the beats (elastic.py).  Block
+                    # on the PREVIOUS step's metrics: step i is already
+                    # dispatched, so waiting for i-1 keeps one step of
+                    # host/device overlap instead of serializing dispatch.
+                    if pending_metrics is not None:
+                        jax.block_until_ready(pending_metrics)
+                        if watchdog is None:
+                            watchdog = StepWatchdog(
+                                cfg.watchdog_timeout_s
+                            ).start()
+                        watchdog.beat()
+                    pending_metrics = step_metrics
                 if heartbeat:
                     heartbeat.set_step(i + 1)
                 if cfg.log_every and (
@@ -147,6 +155,14 @@ class Trainer:
                     self.ckpt.save(i + 1, state, config=self.run_config)
                 for cb in self.callbacks:
                     cb(i + 1, state, step_metrics)
+            if cfg.watchdog_timeout_s and pending_metrics is not None:
+                # flush the lag-one beat: the final step (the only step,
+                # when resuming one short of cfg.steps) must arm/beat the
+                # watchdog so a hang in the closing save/wait is detected
+                jax.block_until_ready(pending_metrics)
+                if watchdog is None:
+                    watchdog = StepWatchdog(cfg.watchdog_timeout_s).start()
+                watchdog.beat()
             if self.ckpt and cfg.ckpt_every:
                 if self.ckpt.latest_step() != cfg.steps:
                     self.ckpt.save(cfg.steps, state, config=self.run_config,
