@@ -41,6 +41,10 @@ class RunCfg:
     new_tokens: int = 64
     temperature: float = 0.8
     top_k: int = 40
+    # 'none' -> plain single-program decode; any planner strategy
+    # ('tp', 'tp_fsdp', 'fsdp', 'dp') -> plan-aware sharded decode
+    # (AutoDistribute.generate: sharded params, KV cache on the mesh)
+    strategy: str = "none"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -63,8 +67,30 @@ def main():
     variables = model.init(jax.random.key(0), prompt)
     sample = SampleConfig(temperature=r.temperature, top_k=r.top_k)
 
-    gen = jax.jit(lambda v, p, k: generate(
-        model, v, p, max_new_tokens=r.new_tokens, sample=sample, rng=k))
+    if r.strategy != "none":
+        import optax
+
+        import torch_automatic_distributed_neural_network_tpu as tad
+        from torch_automatic_distributed_neural_network_tpu.training import (
+            next_token_loss,
+        )
+
+        ad = tad.AutoDistribute(
+            model, optimizer=optax.sgd(0.1), loss_fn=next_token_loss,
+            strategy=r.strategy,
+        )
+        ad.build_plan(
+            jax.random.key(0),
+            {"input_ids": np.zeros(
+                (r.batch_size, r.prompt_len + 1), np.int32)},
+        )
+        print(f"plan: strategy={ad.plan.strategy} "
+              f"mesh={tad.mesh_degrees(ad.plan.mesh)}")
+        gen = lambda v, p, k: ad.generate(
+            v, p, max_new_tokens=r.new_tokens, sample=sample, rng=k)
+    else:
+        gen = jax.jit(lambda v, p, k: generate(
+            model, v, p, max_new_tokens=r.new_tokens, sample=sample, rng=k))
     # fence with a host readback: on the tunneled TPU, block_until_ready
     # does not synchronize (see bench.py readback_overhead_s)
     t0 = time.perf_counter()

@@ -435,6 +435,64 @@ class AutoDistribute:
             variables = {"params": params, **model_state}
         return self._fwd(variables, *args, **kwargs)
 
+    def generate(
+        self,
+        state_or_params,
+        prompt,
+        *,
+        max_new_tokens: int,
+        sample=None,
+        rng: jax.Array | None = None,
+        cache_dtype=jnp.bfloat16,
+    ):
+        """Plan-aware autoregressive generation (inference/decode.py).
+
+        Runs the KV-cached decode loop as ONE jitted program with the
+        plan's shardings: params stay sharded as trained (TP col/row,
+        FSDP), the prompt/output shard on the batch axes, and the KV
+        cache is constrained to batch-on-data / heads-on-tensor
+        (decode.cache_partition_spec).  Works for dense and MoE models.
+        """
+        from .inference import decode
+
+        assert self.plan is not None, "call init() or build_plan() first"
+        if sample is None:
+            sample = decode.SampleConfig(temperature=0.0)
+        params = (
+            state_or_params.params
+            if isinstance(state_or_params, TrainState)
+            else self._split_variables(state_or_params)[0]
+        )
+        if rng is None:
+            rng = jax.random.key(0)
+        mesh = self.plan.mesh
+        key = (max_new_tokens, sample, str(jnp.dtype(cache_dtype)),
+               tuple(getattr(prompt, "shape", ())))
+        cached = getattr(self, "_generate_cache", None)
+        if cached is None:
+            cached = self._generate_cache = {}
+        if key not in cached:
+            def run(params, prompt, rng):
+                return decode.generate(
+                    self.model, {"params": params}, prompt,
+                    max_new_tokens=max_new_tokens, sample=sample, rng=rng,
+                    cache_dtype=cache_dtype, mesh=mesh,
+                )
+
+            cached[key] = jax.jit(
+                run,
+                in_shardings=(
+                    jax.tree.map(
+                        lambda s: NamedSharding(mesh, s),
+                        self.plan.param_specs,
+                        is_leaf=lambda x: isinstance(x, P),
+                    ),
+                    self.plan.batch_sharding(),
+                    None,
+                ),
+            )
+        return cached[key](params, prompt, rng)
+
     def shard_batch(self, batch):
         """Place a batch onto the mesh with the plan's sharding.
 

@@ -11,10 +11,14 @@ sequences; decoding re-runs the same weights through a functional cache:
 
 The cache is an explicit pytree (no flax mutable collections), so it
 shards like any other activation: [L, B, S_max, kvH, hd] with batch on
-the data axes.  Works for both decoder families (GPT-2: layernorm /
-learned-pos / gelu / tied; Llama: rmsnorm / rope / swiglu / GQA /
-untied).  MoE decode is not implemented yet (routing under a cache is a
-separate path).
+the data axes and kv heads on the tensor axis (``generate(mesh=...)`` or
+``AutoDistribute.generate`` applies the constraints; GSPMD propagates
+them through the cache updates).  Works for both decoder families
+(GPT-2: layernorm / learned-pos / gelu / tied; Llama: rmsnorm / rope /
+swiglu / GQA / untied) and for MoE models (MoELM): decode-time routing
+is dispatch-free — all experts run on the (tiny) decode chunk and the
+top-k gate weights combine them, which matches the training router's
+greedy-top-k + renormalized gates exactly when no token is dropped.
 
 Numerics are cross-checked against ``model.apply`` on the full prefix in
 tests/test_generate.py.
@@ -90,6 +94,39 @@ def _cached_attention(q, k_cache, v_cache, q_pos, kv_len):
                          mask=mask[None, None])
 
 
+def _moe_mlp_cached(lp_mlp: Any, h: jax.Array, cfg) -> jax.Array:
+    """Dispatch-free MoE FFN for decode chunks: run every expert on the
+    chunk and combine with the router's renormalized top-k gates.
+
+    Matches parallel/expert.top_k_routing numerics (greedy top-k on the
+    softmax, renormalized gates) in the no-drop regime — decode never
+    drops tokens since there is no capacity buffer.  Costs E/k times the
+    routed FLOPs, which is irrelevant at decode chunk sizes.
+    """
+    E = lp_mlp["experts_up"].shape[0]
+    logits = jnp.einsum(
+        "btd,de->bte", h.astype(jnp.float32), lp_mlp["router"]["kernel"]
+    )
+    probs = jax.nn.softmax(logits, axis=-1)
+    topv, topi = jax.lax.top_k(probs, cfg.top_k)
+    gates = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
+    w = (jax.nn.one_hot(topi, E, dtype=jnp.float32)
+         * gates[..., None]).sum(-2)  # [B,T,E]
+
+    up = lp_mlp["experts_up"].astype(h.dtype)
+    down = lp_mlp["experts_down"].astype(h.dtype)
+    hidden = jnp.einsum("btd,edf->btef", h, up)
+    if "experts_gate" in lp_mlp:
+        gate_w = lp_mlp["experts_gate"].astype(h.dtype)
+        hidden = jax.nn.silu(
+            jnp.einsum("btd,edf->btef", h, gate_w)
+        ) * hidden
+    else:
+        hidden = jax.nn.gelu(hidden)
+    y = jnp.einsum("btef,efd->bted", hidden, down)
+    return jnp.einsum("bted,bte->btd", y, w.astype(h.dtype))
+
+
 def forward_cached(
     params: Any,
     cfg: TransformerConfig,
@@ -133,12 +170,15 @@ def forward_cached(
         x = x + _dense(lp["attn"]["o_proj"], o.astype(dtype),
                        fold_out=True, bias=bias)
         h = _norm(x, lp["mlp_norm"], cfg.norm)
-        if cfg.act == "swiglu":
+        if "experts_up" in lp["mlp"]:
+            x = x + _moe_mlp_cached(lp["mlp"], h, cfg)
+        elif cfg.act == "swiglu":
             hidden = jax.nn.silu(_dense(lp["mlp"]["gate_proj"], h, bias=bias))
             hidden = hidden * _dense(lp["mlp"]["up_proj"], h, bias=bias)
+            x = x + _dense(lp["mlp"]["down_proj"], hidden, bias=bias)
         else:
             hidden = jax.nn.gelu(_dense(lp["mlp"]["up_proj"], h, bias=bias))
-        x = x + _dense(lp["mlp"]["down_proj"], hidden, bias=bias)
+            x = x + _dense(lp["mlp"]["down_proj"], hidden, bias=bias)
         return x, (k_cache, v_cache)
 
     def scan_body(x, xs):
@@ -175,6 +215,23 @@ def _sample(logits: jax.Array, rng: jax.Array, sc: SampleConfig) -> jax.Array:
     return jax.random.categorical(rng, logits).astype(jnp.int32)
 
 
+def cache_partition_spec(
+    cfg, mesh,
+    batch_axes: tuple[str, ...] = ("data", "fsdp", "expert"),
+    head_axis: str = "tensor",
+):
+    """PartitionSpec for the [L, B, S, kvH, hd] cache under ``mesh``:
+    batch rows on the data axes, kv heads on the tensor axis (matching
+    the col-split k/v projections) when the head count divides it."""
+    from jax.sharding import PartitionSpec as P
+
+    degrees = dict(zip(mesh.axis_names, mesh.devices.shape))
+    present = tuple(a for a in batch_axes if degrees.get(a, 1) > 1)
+    t = degrees.get(head_axis, 1)
+    head_entry = head_axis if t > 1 and cfg.kv_heads % t == 0 else None
+    return P(None, present if present else None, None, head_entry, None)
+
+
 def generate(
     model,
     variables: Any,
@@ -184,11 +241,16 @@ def generate(
     sample: SampleConfig = SampleConfig(temperature=0.0),
     rng: jax.Array | None = None,
     cache_dtype=jnp.bfloat16,
+    mesh=None,
 ) -> jax.Array:
     """Autoregressive generation: prefill + one-token lax.scan decode.
 
     Returns [B, P + max_new_tokens].  The whole loop compiles to a single
     XLA program; re-invoking with the same shapes reuses the executable.
+    With ``mesh``, the KV cache is sharding-constrained (batch on data
+    axes, kv heads on tensor — :func:`cache_partition_spec`) so decode
+    runs sharded under a plan's mesh (AutoDistribute.generate wraps this
+    with the right jit shardings).
     """
     cfg: TransformerConfig = model.cfg
     params = variables["params"]
@@ -199,6 +261,15 @@ def generate(
     rng, first_rng = jax.random.split(rng)
 
     cache = KVCache.init(cfg, B, P + max_new_tokens, dtype=cache_dtype)
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+
+        kv_sharding = NamedSharding(mesh, cache_partition_spec(cfg, mesh))
+        cache = KVCache(
+            k=jax.lax.with_sharding_constraint(cache.k, kv_sharding),
+            v=jax.lax.with_sharding_constraint(cache.v, kv_sharding),
+            length=cache.length,
+        )
     logits, cache = forward_cached(params, cfg, prompt, cache)
     first = _sample(logits, first_rng, sample)
 

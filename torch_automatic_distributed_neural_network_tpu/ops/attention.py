@@ -99,6 +99,8 @@ def attention(
     ctx = pctx.current()
     cp = ctx.seq_degree if ctx is not None else 1
 
+    if impl == "auto" and ctx is not None and ctx.attn_impl:
+        impl = ctx.attn_impl
     if impl == "auto":
         if cp > 1:
             if ctx.seq_impl in ("ring", "ulysses"):

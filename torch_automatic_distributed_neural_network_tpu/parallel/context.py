@@ -34,6 +34,11 @@ class ParallelContext:
     # False when the model body runs inside a shard_map region (pipeline
     # stages): mesh-axis sharding constraints are meaningless per-shard.
     enable_constraints: bool = True
+    # When set, overrides attention's impl='auto' dispatch (ops/attention).
+    # The pipeline region forces 'xla': a Mosaic custom call cannot be
+    # GSPMD-partitioned over the auto axes of a partial-manual region,
+    # while einsum attention partitions fine.
+    attn_impl: str | None = None
 
     @property
     def degrees(self) -> dict[str, int]:

@@ -6,21 +6,37 @@ microbatch loop inside a `shard_map` region, with activations hopping to
 the next stage over the ICI ring via `ppermute`.
 
 Layout: the decoder's scanned layer stack gives parameters a leading
-``[n_layers, ...]`` dim (models/transformer_core.py:192-199).  Sharding
-that dim over the ``pipe`` mesh axis hands each pipe rank a contiguous
-block of ``n_layers / n_stages`` layers — its stage.  Inside the stage,
-layers run under a local `lax.scan`; between stages, the activation is
-`ppermute`d one hop.  Reverse-mode AD through the scan+ppermute yields the
-GPipe backward schedule automatically (full forward, then full backward,
-per microbatch) — no hand-written backward pass.
+``[n_layers, ...]`` dim (models/transformer_core.py).  Sharding that dim
+over the ``pipe`` mesh axis hands each pipe rank a contiguous block of
+``n_layers / n_stages`` layers — its stage.  Inside the stage, layers run
+under a local `lax.scan`; between stages, the activation is `ppermute`d
+one hop.  Reverse-mode AD through the scan+ppermute yields the GPipe
+backward schedule automatically (full forward, then full backward, per
+microbatch) — no hand-written backward pass.
+
+v2 — partial-manual shard_map: the region is manual over the ``pipe``
+axis ONLY (``axis_names={'pipe'}``); every other mesh axis stays under
+GSPMD's automatic partitioning *inside* the region.  That is what makes
+the compositions work with zero extra collective code:
+
+- pipe x tensor: the planner leaves the Megatron col/row specs on the
+  stacked layer weights' trailing dims (planner.param_spec_tree), and
+  GSPMD partitions each stage's matmuls over ``tensor`` as usual;
+- pipe x data/fsdp: the microbatch tensors stay batch-sharded over the
+  data axes inside the region.
+
+Attention inside stages runs as einsum (``attn_impl='xla'`` via the
+ParallelContext): a Mosaic/Pallas custom call cannot be GSPMD-partitioned
+over the auto axes of a partial-manual region.
+
+Dropout rngs thread through stages: each (microbatch, layer) folds its
+own key from the step rng, so the pattern is schedule-independent and
+deterministic under resume.
 
 Schedule cost: ``M + S - 1`` iterations for M microbatches on S stages;
 bubble fraction ``(S-1)/(M+S-1)``.  Every rank computes every iteration
 (bubble iterations compute on garbage and are masked out) — uniform SPMD
 compute, which is what keeps this a single XLA program.
-
-Composability (v1): pipe × data/fsdp.  Tensor parallelism inside a
-shard_map stage would need manual collectives — planned, not yet wired.
 """
 
 from __future__ import annotations
@@ -45,20 +61,22 @@ def _to_varying(x, axis_name: str):
 
 
 def spmd_pipeline(
-    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_fn: Callable[[Any, jax.Array, jax.Array], jax.Array],
     stage_params: Any,
     microbatches: jax.Array,
     *,
     n_stages: int,
     axis_name: str = "pipe",
 ) -> jax.Array:
-    """GPipe microbatch loop.  MUST run inside `shard_map` with
-    ``stage_params`` sharded on ``axis_name`` (leading dim) and
-    ``microbatches`` of local shape ``[M, mb, ...]`` replicated along it.
+    """GPipe microbatch loop.  MUST run inside `shard_map` manual over
+    ``axis_name`` with ``stage_params`` sharded on it (leading dim) and
+    ``microbatches`` of shape ``[M, mb, ...]`` replicated along it.
 
-    ``stage_fn(local_stage_params, x) -> y`` applies one stage's layers;
-    activation shape/dtype must be preserved (transformer blocks are).
-    Returns ``[M, mb, ...]`` outputs, replicated along ``axis_name``.
+    ``stage_fn(local_stage_params, x, mb_idx) -> y`` applies one stage's
+    layers to microbatch ``mb_idx`` (the schedule-independent microbatch
+    id, for rng folding); activation shape/dtype must be preserved
+    (transformer blocks are).  Returns ``[M, mb, ...]`` outputs,
+    replicated along ``axis_name``.
     """
     S = n_stages
     M = microbatches.shape[0]
@@ -67,14 +85,17 @@ def spmd_pipeline(
     # mark loop state as device-varying along the pipe axis so the scan
     # carry type is stable (jax vma tracking inside shard_map)
     microbatches = _to_varying(microbatches, axis_name)
-    mb_aval = jax.eval_shape(lambda x: x[0], microbatches)
-    out_aval = jax.eval_shape(stage_fn, stage_params, mb_aval)
-    if out_aval.shape != mb_aval.shape or out_aval.dtype != mb_aval.dtype:
-        raise ValueError(
-            f"pipeline stage_fn must preserve activation shape/dtype; "
-            f"got {mb_aval.shape}/{mb_aval.dtype} -> "
-            f"{out_aval.shape}/{out_aval.dtype}"
-        )
+
+    def checked_stage(params, x, mb_idx):
+        # trace-time shape check (stage_fn may use axis_index, which
+        # eval_shape outside the region cannot trace)
+        y = stage_fn(params, x, mb_idx)
+        if y.shape != x.shape or y.dtype != x.dtype:
+            raise ValueError(
+                f"pipeline stage_fn must preserve activation shape/dtype; "
+                f"got {x.shape}/{x.dtype} -> {y.shape}/{y.dtype}"
+            )
+        return y
 
     # zeros_like inherits every varying axis of the (cast) microbatches —
     # e.g. 'data' when the batch is also sharded — keeping scan carry types
@@ -85,8 +106,11 @@ def spmd_pipeline(
 
     def body(carry, t):
         act, outputs = carry
-        # stage 0 ingests microbatch t (clamped: bubble iterations redo the
-        # last one and their results are never stored)
+        # the microbatch this stage works on at iteration t (bubble
+        # iterations clamp and redo a boundary microbatch; their results
+        # are never stored)
+        mb_idx = jnp.clip(t - stage, 0, M - 1)
+        # stage 0 ingests microbatch t
         inp = jnp.where(
             stage == 0,
             jax.lax.dynamic_index_in_dim(
@@ -94,7 +118,7 @@ def spmd_pipeline(
             ),
             act,
         )
-        out = stage_fn(stage_params, inp)
+        out = checked_stage(stage_params, inp, mb_idx)
         # the last stage finishes microbatch t-(S-1) at iteration t
         out_idx = jnp.clip(t - (S - 1), 0, M - 1)
         is_done = jnp.logical_and(stage == S - 1, t >= S - 1)
@@ -109,13 +133,14 @@ def spmd_pipeline(
     (_, outputs), _ = jax.lax.scan(
         body, (act0, outputs0), jnp.arange(M + S - 1)
     )
-    # only the last stage holds real outputs — masked psum broadcasts them
-    # so the shard_map out_spec is replicated along the pipe axis
-    outputs = jax.lax.psum(
-        jnp.where(stage == S - 1, outputs, jnp.zeros_like(outputs)),
-        axis_name,
-    )
-    return outputs
+    # Only the last stage holds real outputs — masked psum broadcasts them
+    # so the shard_map out_spec is replicated along the pipe axis.  The
+    # result stays fp32 THROUGH the region boundary: the replication-
+    # materializing all-reduce(copy) the partial-manual boundary emits
+    # trips a CHECK in XLA:CPU's AllReducePromotion pass when it is bf16
+    # (callers cast back outside the region).
+    masked = jnp.where(stage == S - 1, outputs, jnp.zeros_like(outputs))
+    return jax.lax.psum(masked.astype(jnp.float32), axis_name)
 
 
 # ---------------------------------------------------------------------------
@@ -131,18 +156,21 @@ def make_pipelined_apply(
     axis_name: str = "pipe",
     remat: bool | None = None,
 ) -> Callable:
-    """Build ``apply(variables, tokens) -> logits`` running ``model``'s
-    layer stack as a GPipe pipeline over ``mesh``'s ``pipe`` axis.
+    """Build ``apply(variables, tokens, rngs=...) -> logits`` running
+    ``model``'s layer stack as a GPipe pipeline over ``mesh``'s ``pipe``
+    axis.
 
     ``model`` must be a ``DecoderLM`` (models/transformer_core.py) with
     ``scan_layers=True`` — the scanned stack's leading dim is what the
     pipeline shards into stages.  Embedding and LM head run outside the
-    shard_map region, replicated across the pipe axis (GSPMD shards them
-    over data/tensor axes as usual); only the O(n_layers) trunk — where
-    the parameters live — is pipelined.
+    shard_map region (GSPMD shards them over data/tensor axes as usual);
+    only the O(n_layers) trunk — where the parameters live — is
+    pipelined.  Tensor-parallel stages need no special handling: the
+    region is manual over ``pipe`` only, so the stacked weights'
+    col/row specs partition each stage's matmuls automatically.
 
-    Mirrors DecoderLM.__call__ (transformer_core.py:168-212); the parity
-    test (tests/test_pipeline.py) pins the two together.
+    Mirrors DecoderLM.__call__; the parity test (tests/test_pipeline.py)
+    pins the two together.
     """
     from ..models.transformer_core import DecoderLayer, DecoderLM, make_norm
 
@@ -154,11 +182,6 @@ def make_pipelined_apply(
     cfg = model.cfg
     if not cfg.scan_layers:
         raise ValueError("pipeline parallelism requires cfg.scan_layers=True")
-    if cfg.dropout_rate:
-        raise ValueError(
-            "pipeline v1 does not thread dropout rngs through stages; "
-            "set dropout_rate=0"
-        )
     S = topo_mod.mesh_degrees(mesh).get(axis_name, 1)
     if S <= 1:
         raise ValueError(f"mesh has no {axis_name!r} axis > 1")
@@ -167,55 +190,82 @@ def make_pipelined_apply(
             f"n_layers={cfg.n_layers} not divisible by {S} pipeline stages"
         )
     M = n_microbatches
+    L_local = cfg.n_layers // S
 
     layer = DecoderLayer(cfg)
 
-    def one_layer(p, x, positions):
-        return layer.apply({"params": p}, x, positions)
+    def one_layer(p, x, positions, rngs):
+        return layer.apply({"params": p}, x, positions, rngs=rngs)
 
     if cfg.remat if remat is None else remat:
         one_layer = jax.checkpoint(
             one_layer,
-            policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+            policy=(
+                jax.checkpoint_policies.nothing_saveable
+                if cfg.remat_policy == "nothing"
+                else jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+            ),
         )
 
-    def stage_fn(stage_params, x):
-        positions = jnp.arange(x.shape[1])[None, :]
+    def make_stage_fn(key_data):
+        def stage_fn(stage_params, x, mb_idx):
+            # fp32 in/out: activations and their cotangents cross every
+            # stage hop and the region boundary in fp32 (see pipe_region);
+            # compute inside the stage stays in the model dtype
+            x = x.astype(cfg.dtype)
+            positions = jnp.arange(x.shape[1])[None, :]
+            stage = jax.lax.axis_index(axis_name)
 
-        def body(carry, p):
-            return one_layer(p, carry, positions), None
+            def body(carry, xs):
+                p, li = xs
+                if cfg.dropout_rate:
+                    # schedule-independent key: one stream per
+                    # (microbatch, global layer) pair
+                    base = jax.random.wrap_key_data(key_data)
+                    global_layer = stage * L_local + li
+                    key = jax.random.fold_in(
+                        base, mb_idx * cfg.n_layers + global_layer
+                    )
+                    rngs = {"dropout": key}
+                else:
+                    rngs = None
+                return one_layer(p, carry, positions, rngs), None
 
-        y, _ = jax.lax.scan(body, x, stage_params)
-        return y
+            y, _ = jax.lax.scan(
+                body, x, (stage_params, jnp.arange(L_local))
+            )
+            return y.astype(jnp.float32)
 
-    from ..planner import batch_partition_spec
+        return stage_fn
+
     from . import context as pctx
 
-    x_spec = batch_partition_spec(mesh)  # batch on data axes; rest replicated
-
-    def pipe_region(layer_params, x):
-        b_local = x.shape[0]
-        if b_local % M:
+    def pipe_region(layer_params, x, key_data):
+        b = x.shape[0]
+        if b % M:
             raise ValueError(
-                f"per-device batch {b_local} not divisible by "
-                f"{M} microbatches"
+                f"batch {b} not divisible by {M} microbatches"
             )
-        mbs = x.reshape((M, b_local // M) + x.shape[1:])
-        # drop the ambient ParallelContext: inside this shard_map region
-        # everything is device-local, so attention must not wrap its own
-        # shard_map (ops/attention.py flash path) — with no context the
-        # flash kernel is called directly, which is the right thing here
-        with pctx.use(None):
+        mbs = x.reshape((M, b // M) + x.shape[1:])
+        # Inside the region: manual over pipe, auto over everything else.
+        # Mesh-axis sharding constraints are disabled (they would name
+        # auto axes from inside a manual region) and attention is forced
+        # to the einsum path, which GSPMD partitions over the auto axes.
+        with pctx.use(pctx.ParallelContext(
+            mesh=mesh, enable_constraints=False, attn_impl="xla",
+        )):
             out = spmd_pipeline(
-                stage_fn, layer_params, mbs, n_stages=S, axis_name=axis_name
+                make_stage_fn(key_data), layer_params, mbs,
+                n_stages=S, axis_name=axis_name,
             )
-        return out.reshape(x.shape)
+        return out.reshape(x.shape)  # fp32 across the region boundary
 
     pipe = shard_map(
         pipe_region,
         mesh=mesh,
-        in_specs=(P(axis_name), x_spec),
-        out_specs=x_spec,
+        in_specs=(P(axis_name), P(), P()),
+        out_specs=P(),
+        axis_names={axis_name},
     )
 
     embed = nn.Embed(
@@ -223,19 +273,34 @@ def make_pipelined_apply(
         embedding_init=nn.initializers.normal(0.02),
     )
 
-    def apply(variables, tokens, positions=None, mask=None):
+    def apply(variables, tokens, positions=None, mask=None, rngs=None):
         if positions is not None or mask is not None:
             raise NotImplementedError(
                 "pipelined apply does not thread custom positions/mask "
                 "through stages yet — use default causal attention"
             )
+        dropout_key = (rngs or {}).get("dropout")
+        if cfg.dropout_rate and dropout_key is None:
+            raise ValueError(
+                "cfg.dropout_rate > 0 needs rngs={'dropout': key}"
+            )
+        key_data = jax.random.key_data(
+            dropout_key if dropout_key is not None else jax.random.key(0)
+        )
         params = variables["params"] if "params" in variables else variables
         x = embed.apply({"params": params["embed"]}, tokens)
         if cfg.pos == "learned":
             x = x + params["pos_embed"][None, : tokens.shape[1]].astype(
                 cfg.dtype
             )
-        x = pipe(params["layers"], x)
+        # The pipelined trunk transports activations (and their backward
+        # cotangents — the transpose of the region's pcast is a psum) in
+        # fp32: bf16 vma-inserted all-reduces trip a CHECK in XLA:CPU's
+        # AllReducePromotion pass (reducer contains a Sharding custom-call
+        # it cannot clone), and fp32 residual transport across stage hops
+        # is numerically conservative anyway.  Stage compute stays bf16.
+        x = pipe(params["layers"], x.astype(jnp.float32), key_data)
+        x = x.astype(cfg.dtype)
         x = make_norm(cfg, "final_norm").apply(
             {"params": params["final_norm"]}, x
         )

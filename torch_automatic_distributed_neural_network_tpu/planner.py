@@ -267,8 +267,21 @@ def param_spec_tree(
             and shape
             and shape[0] % pipe == 0
         ):
-            # leading layer-stack dim -> pipeline stages (parallel/pipeline.py)
-            spec = P("pipe")
+            # leading layer-stack dim -> pipeline stages (parallel/
+            # pipeline.py); under pipe x tp the trailing dims keep their
+            # Megatron col/row split (the stage-local TP composition)
+            entries: list[Axis] = [None] * len(shape)
+            if use_tp:
+                for rule in rules:
+                    if rule.matches(path):
+                        tp = _spec_from_rule(rule, shape, degrees)
+                        if tp is not None:
+                            entries = list(tp)
+                            entries += [None] * (len(shape) - len(entries))
+                        break
+            if entries[0] is None:
+                entries[0] = "pipe"
+            spec = _norm_spec(entries)
         if spec is None and use_ep:
             for rule in MOE_RULES:
                 if rule.matches(path):
@@ -428,9 +441,9 @@ def make_plan(
     known = ("auto", "dp", "fsdp", "tp", "tp_fsdp", "ep", "ep_fsdp")
     if strategy not in known:
         raise ValueError(f"Unknown strategy {strategy!r}; expected one of {known}")
-    if pipe > 1 and strategy in ("tp", "tp_fsdp", "ep", "ep_fsdp"):
+    if pipe > 1 and strategy in ("ep", "ep_fsdp"):
         raise ValueError(
-            "pipeline parallelism composes with dp/fsdp only (v1); "
+            "pipeline parallelism composes with dp/fsdp/tp (v2); "
             f"strategy {strategy!r} + pipe={pipe} is not supported"
         )
     topo = topo_mod.detect(devices)
@@ -457,8 +470,8 @@ def make_plan(
                 abstract_params, dataclasses.replace(topo, num_devices=n),
                 rules, state_factor=state_factor,
             )
-            if pipe > 1 and resolved in ("tp", "tp_fsdp", "ep", "ep_fsdp"):
-                # v1: pp composes with dp/fsdp only
+            if pipe > 1 and resolved in ("ep", "ep_fsdp"):
+                # pp x expert-parallel is not wired; fall back to fsdp
                 resolved, degrees = "fsdp", {"fsdp": n}
         elif strategy == "dp":
             degrees = {"data": n}

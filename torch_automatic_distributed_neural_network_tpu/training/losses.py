@@ -40,7 +40,10 @@ def next_token_loss(params, batch, rng, apply_fn):
     """Causal LM: predict token t+1 from tokens <= t; ignores padding 0s
     if an explicit ``mask`` is present."""
     tokens = batch.get("input_ids", batch.get("tokens"))
-    logits = apply_fn(params, tokens[:, :-1])
+    logits = apply_fn(
+        params, tokens[:, :-1],
+        rngs={"dropout": rng} if rng is not None else None,
+    )
     loss, denom = _shifted_xent(logits, tokens, batch.get("mask"))
     return loss, {"tokens": denom}
 
@@ -65,7 +68,10 @@ def moe_next_token_loss(params, batch, rng, apply_fn):
     next_token_loss's cross-entropy plus the router load-balance/z losses
     (models/moe.py)."""
     tokens = batch.get("input_ids", batch.get("tokens"))
-    logits, aux_loss = apply_fn(params, tokens[:, :-1])
+    logits, aux_loss = apply_fn(
+        params, tokens[:, :-1],
+        rngs={"dropout": rng} if rng is not None else None,
+    )
     xent, _ = _shifted_xent(logits, tokens, batch.get("mask"))
     return xent + aux_loss, {"xent": xent, "router_loss": aux_loss}
 
@@ -74,7 +80,10 @@ def seq2seq_loss(params, batch, rng, apply_fn):
     """Teacher-forced MT loss: predict tgt[t+1] from src + tgt[<=t];
     target positions equal to 0 are treated as padding."""
     src, tgt = batch["src"], batch["tgt"]
-    logits = apply_fn(params, src, tgt[:, :-1])
+    logits = apply_fn(
+        params, src, tgt[:, :-1],
+        rngs={"dropout": rng} if rng is not None else None,
+    )
     targets = tgt[:, 1:]
     losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
     mask = (targets != 0).astype(losses.dtype)
