@@ -21,6 +21,10 @@ import jax
 import optax
 
 import torch_automatic_distributed_neural_network_tpu as tad
+from torch_automatic_distributed_neural_network_tpu.data import (
+    classification_dataset,
+    load_mnist,
+)
 from torch_automatic_distributed_neural_network_tpu.data.synthetic import (
     SyntheticClassification,
 )
@@ -38,10 +42,16 @@ def main():
     p.add_argument("--strategy", default="auto",
                    choices=["auto", "dp", "fsdp", "tp", "tp_fsdp"])
     p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--data-dir", default="",
+                   help="dir with MNIST idx files or x_train/y_train.npy; "
+                        "falls back to synthetic when empty/absent")
     args = p.parse_args()
 
     print(f"devices: {jax.device_count()} x {jax.devices()[0].device_kind}")
-    data = SyntheticClassification(batch_size=args.batch_size)
+    data = classification_dataset(
+        args.data_dir, load_mnist, args.batch_size,
+        fallback=lambda: SyntheticClassification(batch_size=args.batch_size),
+    )
     ad = tad.AutoDistribute(
         MLP(features=(512, 256, 10)),
         optimizer=optax.sgd(args.lr),

@@ -20,6 +20,10 @@ import jax
 import optax
 
 import torch_automatic_distributed_neural_network_tpu as tad
+from torch_automatic_distributed_neural_network_tpu.data import (
+    classification_dataset,
+    load_cifar10,
+)
 from torch_automatic_distributed_neural_network_tpu.data.synthetic import (
     SyntheticClassification,
 )
@@ -48,6 +52,9 @@ class RunCfg:
     lr: float = 0.1
     log_every: int = 10
     metrics_path: str = ""
+    # dir with cifar-10-batches-py pickles or x_train/y_train.npy;
+    # synthetic fallback when empty/absent
+    data_dir: str = ""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -73,10 +80,20 @@ def main():
     else:
         model = ResNet50(num_classes=10, small_inputs=True)
         image_shape = (32, 32, 3)
-    data = SyntheticClassification(
-        image_shape=image_shape, num_classes=10,
-        batch_size=cfg.run.batch_size,
+    data = classification_dataset(
+        cfg.run.data_dir, load_cifar10, cfg.run.batch_size,
+        fallback=lambda: SyntheticClassification(
+            image_shape=image_shape, num_classes=10,
+            batch_size=cfg.run.batch_size,
+        ),
     )
+    if not isinstance(data, SyntheticClassification) and (
+        data.x.shape[1:] != image_shape
+    ):
+        raise SystemExit(
+            f"loaded images {data.x.shape[1:]} do not match the model's "
+            f"expected {image_shape} (arch={cfg.model.arch})"
+        )
     ad = tad.AutoDistribute(
         model,
         optimizer=optax.sgd(cfg.run.lr, momentum=0.9),

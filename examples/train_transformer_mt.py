@@ -21,6 +21,10 @@ import jax
 import optax
 
 import torch_automatic_distributed_neural_network_tpu as tad
+from torch_automatic_distributed_neural_network_tpu.data import (
+    ArraySeq2Seq,
+    load_seq2seq,
+)
 from torch_automatic_distributed_neural_network_tpu.data.synthetic import (
     SyntheticSeq2Seq,
 )
@@ -49,6 +53,9 @@ class RunCfg:
     lr: float = 1e-3
     log_every: int = 10
     metrics_path: str = ""
+    # dir with src[_train].npy / tgt[_train].npy token ids;
+    # synthetic WMT14-shaped fallback when empty/absent
+    data_dir: str = ""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -71,10 +78,19 @@ def main():
     vocab = 512 if cfg.model.size == "test" else cfg.model.vocab_size
     model = TransformerMT(cfg.model.size, vocab_size=vocab,
                           max_seq_len=max(cfg.model.src_len, cfg.model.tgt_len))
-    data = SyntheticSeq2Seq(
-        vocab_size=vocab, src_len=cfg.model.src_len,
-        tgt_len=cfg.model.tgt_len, batch_size=cfg.run.batch_size,
-    )
+    loaded = load_seq2seq(cfg.run.data_dir) if cfg.run.data_dir else None
+    if loaded is not None:
+        src, tgt = loaded
+        print(f"data: {len(src)} pairs from {cfg.run.data_dir}")
+        data = ArraySeq2Seq(src, tgt, cfg.run.batch_size)
+    else:
+        if cfg.run.data_dir:
+            print(f"data: nothing loadable in {cfg.run.data_dir!r}; "
+                  "using synthetic")
+        data = SyntheticSeq2Seq(
+            vocab_size=vocab, src_len=cfg.model.src_len,
+            tgt_len=cfg.model.tgt_len, batch_size=cfg.run.batch_size,
+        )
     ad = tad.AutoDistribute(
         model,
         optimizer=optax.adam(cfg.run.lr),

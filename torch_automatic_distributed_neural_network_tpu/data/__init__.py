@@ -1,10 +1,25 @@
-"""Input pipelines: synthetic datasets, the native token-file loader and
+"""Input pipelines: synthetic datasets, on-disk array datasets (MNIST idx
+/ CIFAR-10 pickles / npy pairs), the native token-file loader and
 per-host sharded input (C13)."""
 
+from .arrays import (
+    ArrayClassification,
+    ArraySeq2Seq,
+    classification_dataset,
+    load_cifar10,
+    load_mnist,
+    load_seq2seq,
+)
 from .loader import TokenFileDataset, shard_for_host, write_token_file
 from .synthetic import SyntheticClassification, SyntheticLM
 
 __all__ = [
+    "ArrayClassification",
+    "ArraySeq2Seq",
+    "classification_dataset",
+    "load_cifar10",
+    "load_mnist",
+    "load_seq2seq",
     "SyntheticClassification",
     "SyntheticLM",
     "TokenFileDataset",
