@@ -116,9 +116,16 @@ def timed_lm_bench(ad, data, *, flop_params, seq, batch, steps):
     flops_mult = 8.0 / 6.0 if ad.plan.remat else 1.0
     flops = transformer_step_flops(flop_params, tokens_per_step) * flops_mult
     mfu = flops / dt / (peak_flops_per_chip() * n_chips)
+    # Two distinct remat knobs (advisor round-2): the planner's OUTER
+    # loss-level jax.checkpoint (ad.plan.remat) and the model's PER-LAYER
+    # nn.remat policy (e.g. 'nothing' = full per-layer recompute).  Print
+    # both so the artifact alone is unambiguous.
+    model_cfg = getattr(getattr(ad, "model", None), "cfg", None)
+    layer_policy = getattr(model_cfg, "remat_policy", None) if getattr(
+        model_cfg, "remat", False) else "off"
     log(f"mean step {dt*1e3:.1f}ms  {tps_chip:,.0f} tokens/s/chip  "
-        f"MFU {mfu:.1%} (remat={'on' if ad.plan.remat else 'off'}, "
-        f"strategy={ad.plan.strategy})")
+        f"MFU {mfu:.1%} (remat: outer={'on' if ad.plan.remat else 'off'}, "
+        f"per-layer={layer_policy or 'n/a'}; strategy={ad.plan.strategy})")
     return tps_chip, mfu, dt, n_chips
 
 
@@ -250,29 +257,255 @@ def bench_resnet(args):
         loss_fn=softmax_xent_loss_mutable,
         strategy="dp",
     )
+    t0 = time.perf_counter()
     state = ad.init(jax.random.key(0), data.batch(0))
     state, m = ad.step(state, data.batch(0))
     float(m["loss"])
+    log(f"compile+init: {time.perf_counter()-t0:.1f}s batch={batch}")
     # Pre-stage a few distinct batches on device: this benchmark measures
     # TPU step throughput; input-pipeline cost (host RNG + the ~30 MB/s
     # axon tunnel for 77 MB image batches) is reported separately by the
     # loader microbenches, and real runs overlap transfers with dispatch.
+    t0 = time.perf_counter()
     staged = [ad.shard_batch(data.batch(i)) for i in range(8)]
     jax.block_until_ready(staged)  # finish transfers before the timed loop
+    log(f"staged 8 batches: {time.perf_counter()-t0:.1f}s")
     # warm with a *staged* batch: committed device arrays compile a
     # separate executable from host-numpy args (measured 29s on axon)
     state, m = ad.step(state, staged[0])
     float(m["loss"])
     batches = [staged[i % len(staged)] for i in range(steps)]
     state, dt = timed_chain(ad.step, state, batches)
-    ips_chip = batch / dt / jax.device_count()
-    log(f"mean step {dt*1e3:.1f}ms  {ips_chip:,.0f} images/s/chip")
+    n_chips = jax.device_count()
+    ips_chip = batch / dt / n_chips
+    # Analytic conv FLOP model (2/MAC, bwd=2x fwd) -> MFU against the same
+    # 40%-MFU north star the GPT-2 metric uses (BASELINE.json:5).  Cross-
+    # checked against XLA cost_analysis when the backend exposes it.
+    from torch_automatic_distributed_neural_network_tpu.training import (
+        peak_flops_per_chip,
+    )
+    cfg = ad.model.cfg
+    flops = cfg.train_step_flops((224, 224), batch)
+    mfu = flops / dt / (peak_flops_per_chip() * n_chips)
+    # Cross-check against XLA cost_analysis only on request: the AOT
+    # lower().compile() does not reuse the jit cache, and a ResNet step
+    # recompile costs ~29s on the tunneled axon TPU.
+    xla_flops = None
+    if args.get("xla_flops"):
+        from torch_automatic_distributed_neural_network_tpu.utils.profiling import (
+            compiled_flops,
+        )
+        xla_flops = (compiled_flops(ad._step_fn, state, staged[0])
+                     if ad._step_fn is not None else None)
+    log(f"mean step {dt*1e3:.1f}ms  {ips_chip:,.0f} images/s/chip  "
+        f"MFU {mfu:.1%} (analytic {flops/1e12:.2f} TFLOP/step"
+        + (f", xla cost_analysis {xla_flops/1e12:.2f}" if xla_flops else "")
+        + ")")
     return {
         "metric": "resnet50_images_per_sec_per_chip",
         "value": round(ips_chip, 1),
         "unit": "images/s/chip",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "extra": {
+            "batch": batch,
+            "step_time_ms": round(dt * 1e3, 2),
+            "mfu": round(mfu, 4),
+            "flops_per_step_analytic": flops,
+            "flops_per_step_xla": xla_flops,
+            "n_chips": n_chips,
+        },
+    }
+
+
+def bench_attention(args):
+    """Isolate the Pallas flash kernel's win vs plain XLA einsum attention
+    (fwd+bwd) at seq 512 / 2k / 8k — the native-tier justification
+    (SURVEY.md §2.3; VERDICT round-2 weak #7).
+
+    FLOP accounting: causal attention does 0.5 * 12 * B*H*S^2*D model
+    FLOPs fwd+bwd (4 S^2-matmuls fwd, 2x that bwd, half masked).  Both
+    impls are credited the same useful FLOPs, so TFLOP/s compare directly
+    even though the einsum path really computes the masked half too.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from torch_automatic_distributed_neural_network_tpu.ops.attention import (
+        xla_attention,
+    )
+    from torch_automatic_distributed_neural_network_tpu.training import (
+        peak_flops_per_chip,
+    )
+
+    on_tpu = jax.default_backend() == "tpu"
+    heads, hd = 16, 128
+    rows = []
+    for seq, batch in ((512, 16), (2048, 4), (8192, 1)):
+        key = jax.random.key(seq)
+        kq, kk, kv = jax.random.split(key, 3)
+        shape = (batch, seq, heads, hd)
+        q = jax.random.normal(kq, shape, jnp.bfloat16)
+        k = jax.random.normal(kk, shape, jnp.bfloat16)
+        v = jax.random.normal(kv, shape, jnp.bfloat16)
+        flops = 0.5 * 12 * batch * heads * seq * seq * hd
+
+        impls = {"xla": lambda q_, k_, v_: xla_attention(
+            q_, k_, v_, causal=True)}
+        if on_tpu:
+            from torch_automatic_distributed_neural_network_tpu.ops.flash_attention import (
+                flash_attention,
+            )
+            impls["flash"] = lambda q_, k_, v_: flash_attention(
+                q_, k_, v_, causal=True)
+
+        row = {"seq": seq, "batch": batch}
+        for name, fn in impls.items():
+            def loss(q_, k_, v_):
+                return jnp.sum(fn(q_, k_, v_).astype(jnp.float32))
+
+            grad = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+            g = grad(q, k, v)  # compile
+            jax.block_until_ready(g)
+            overhead = readback_overhead_s()
+            iters = 20 if seq <= 2048 else 10
+            t0 = time.perf_counter()
+            q_c = q
+            for _ in range(iters):
+                g = grad(q_c, k, v)
+                q_c = q_c + 0.0 * g[0]  # chain: keeps dispatch async
+            float(jnp.sum(g[0][0, 0, 0]))  # one readback fence
+            dt = max(time.perf_counter() - t0 - overhead, 1e-9) / iters
+            row[name + "_ms"] = round(dt * 1e3, 3)
+            row[name + "_tflops"] = round(flops / dt / 1e12, 1)
+            row[name + "_hw_util"] = round(flops / dt / peak_flops_per_chip(), 4)
+        if "flash_ms" in row and "xla_ms" in row:
+            row["speedup"] = round(row["xla_ms"] / row["flash_ms"], 2)
+        rows.append(row)
+        log(f"attention seq={seq}: " + "  ".join(
+            f"{k}={v}" for k, v in row.items() if k not in ("seq", "batch")))
+
+    mid = next(r for r in rows if r["seq"] == 2048)
+    value = mid.get("speedup", 0.0)
+    return {
+        "metric": "flash_attention_speedup_vs_xla_seq2048",
+        "value": value,
+        "unit": "x",
+        # vs_baseline: flash hardware utilization at 8k against the 40%
+        # north star (long-seq is where the kernel is load-bearing)
+        "vs_baseline": round(
+            rows[-1].get("flash_hw_util", 0.0) / 0.40, 4),
+        "extra": {"rows": rows, "heads": heads, "head_dim": hd,
+                  "backend": jax.default_backend()},
+    }
+
+
+def _cpu_sim_reexec(n_devices=8, note=""):
+    """Re-exec this bench on the 8-device CPU sim when multi-device is
+    required but only 1 chip is visible (driver env).  Prints the child's
+    JSON line and exits."""
+    import subprocess
+
+    from torch_automatic_distributed_neural_network_tpu.utils.simenv import (
+        cpu_sim_env,
+    )
+
+    env = cpu_sim_env(n_devices)
+    if note:
+        log(note)
+    proc = subprocess.run(
+        [sys.executable, __file__] + sys.argv[1:],
+        env=env, capture_output=True, text=True, timeout=2400,
+    )
+    sys.stderr.write(proc.stderr)
+    if proc.returncode != 0:
+        raise RuntimeError(f"CPU-sim bench failed:\n{proc.stderr[-2000:]}")
+    print(proc.stdout, end="", flush=True)
+    raise SystemExit(0)
+
+
+def bench_pipeline(args):
+    """Microbatch sweep comparing the 'dense' (round-2 GPipe, bubble
+    iterations compute on garbage) and 'cond' (bubbles skip compute via
+    per-device lax.cond) schedules at M=2/4/8 on pipe=2 and pipe=4.
+
+    On the CPU sim the devices share host cores, so skipped bubble FLOPs
+    translate directly into wall-clock — an upper bound on the real-chip
+    win, where bubbles are idle-time and 'cond' mainly saves energy/HBM
+    traffic.  The bubble-iteration fraction (S-1)/(M+S-1) is the model.
+    """
+    import jax
+    import optax
+
+    if jax.device_count() < 4:
+        _cpu_sim_reexec(8, "mode=pipeline: needs >=4 devices; "
+                           "re-running on the 8-device CPU sim")
+
+    import torch_automatic_distributed_neural_network_tpu as tad
+    from torch_automatic_distributed_neural_network_tpu.data.synthetic import (
+        SyntheticLM,
+    )
+    from torch_automatic_distributed_neural_network_tpu.models import GPT2
+    from torch_automatic_distributed_neural_network_tpu.parallel.pipeline import (
+        bubble_fraction,
+    )
+    from torch_automatic_distributed_neural_network_tpu.training import (
+        next_token_loss,
+    )
+
+    seq, vocab = 128, 512
+    steps = min(int(args["steps"]), 10)  # 12 configs; compiles dominate
+    rows = []
+    for stages in (2, 4):
+        for M in (2, 4, 8):
+            # per-device batch (batch / data_degree) must divide every M:
+            # 32 covers data=4 x M=8 at stages=2
+            batch = 32
+            data = SyntheticLM(vocab_size=vocab, seq_len=seq + 1,
+                               batch_size=batch)
+            times = {}
+            for sched in ("dense", "cond"):
+                ad = tad.AutoDistribute(
+                    GPT2("test", vocab_size=vocab, max_seq_len=seq,
+                         n_layers=8),
+                    optimizer=optax.adamw(1e-4),
+                    loss_fn=next_token_loss,
+                    strategy="dp",
+                    pipeline_stages=stages,
+                    microbatches=M,
+                    pipeline_schedule=sched,
+                )
+                state = ad.step(ad.init(jax.random.key(0), data.batch(0)),
+                                data.batch(0))[0]  # compile+warm
+                batches = [data.batch(i) for i in range(steps)]
+                state, dt = timed_chain(ad.step, state, batches)
+                times[sched] = dt
+            row = {
+                "stages": stages, "microbatches": M,
+                "dense_ms": round(times["dense"] * 1e3, 1),
+                "cond_ms": round(times["cond"] * 1e3, 1),
+                "speedup": round(times["dense"] / times["cond"], 3),
+                "bubble_frac": round(bubble_fraction(stages, M), 3),
+            }
+            rows.append(row)
+            log(f"pipe={stages} M={M}: dense {row['dense_ms']}ms "
+                f"cond {row['cond_ms']}ms -> {row['speedup']}x "
+                f"(bubble {row['bubble_frac']:.0%})")
+
+    worst = max(rows, key=lambda r: r["speedup"])
+    return {
+        "metric": "pipeline_cond_schedule_speedup_max",
+        "value": worst["speedup"],
+        "unit": "x",
         "vs_baseline": 0.0,
-        "extra": {"batch": batch, "step_time_ms": round(dt * 1e3, 2)},
+        "extra": {
+            "rows": rows,
+            "backend": jax.default_backend(),
+            "note": (
+                "CPU-sim: shared host cores make skipped bubble compute "
+                "show up as wall-clock; on a real slice 'cond' saves "
+                "energy/HBM traffic during warmup/drain instead"
+            ),
+        },
     }
 
 
@@ -286,41 +519,14 @@ def bench_overlap(args):
     import jax
 
     if jax.device_count() < 2:
-        import os
-        import subprocess
-
         from torch_automatic_distributed_neural_network_tpu.parallel.collectives import (
             LATENCY_HIDING_XLA_FLAGS,
         )
 
-        env = dict(os.environ)
-        pythonpath = [
-            p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-            if p and "axon" not in p
-        ]
-        if pythonpath:
-            env["PYTHONPATH"] = os.pathsep.join(pythonpath)
-        else:
-            env.pop("PYTHONPATH", None)
-        env["JAX_PLATFORMS"] = "cpu"
-        flags = [
-            f for f in env.get("XLA_FLAGS", "").split()
-            if "xla_force_host_platform_device_count" not in f
-        ]
-        env["XLA_FLAGS"] = " ".join(
-            flags + ["--xla_force_host_platform_device_count=8"]
-        )
-        log(f"mode=overlap: 1 device visible; re-running on the 8-device "
-            f"CPU sim (on TPU pods set XLA_FLAGS={LATENCY_HIDING_XLA_FLAGS})")
-        proc = subprocess.run(
-            [sys.executable, __file__] + sys.argv[1:],
-            env=env, capture_output=True, text=True, timeout=1200,
-        )
-        sys.stderr.write(proc.stderr)
-        if proc.returncode != 0:
-            raise RuntimeError(f"CPU-sim overlap bench failed:\n{proc.stderr[-2000:]}")
-        print(proc.stdout, end="", flush=True)
-        raise SystemExit(0)
+        _cpu_sim_reexec(8, (
+            f"mode=overlap: 1 device visible; re-running on the 8-device "
+            f"CPU sim (on TPU pods set XLA_FLAGS={LATENCY_HIDING_XLA_FLAGS})"
+        ))
 
     from torch_automatic_distributed_neural_network_tpu.parallel.collectives import (
         bench_overlap as run_overlap,
@@ -366,7 +572,8 @@ def bench_collectives(args):
 def main():
     args = parse_args()
     fn = {"gpt2": bench_gpt2, "resnet": bench_resnet, "moe": bench_moe,
-          "collectives": bench_collectives, "overlap": bench_overlap}[args["mode"]]
+          "collectives": bench_collectives, "overlap": bench_overlap,
+          "attention": bench_attention, "pipeline": bench_pipeline}[args["mode"]]
     result = fn(args)
     print(json.dumps(result), flush=True)
 

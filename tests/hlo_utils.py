@@ -1,0 +1,74 @@
+"""Structural sharding/HLO inspection helpers for tests.
+
+Round-2 weak #8: the SP signature test grepped lowered StableHLO text for
+``sdy.sharding_constraint`` and a literal ``[{}, {"tensor"}, {}]`` axis
+spelling — strong signal, but tied to the Shardy text format, so a JAX
+upgrade could silently disable it.  These helpers inspect the *jaxpr*
+(``sharding_constraint`` primitives and their ``NamedSharding.spec``)
+which is stable public structure, with a compiled-HLO collective-count
+fallback for end-to-end partitioning evidence.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable
+
+import jax
+
+
+def _walk_jaxpr(jaxpr, visit: Callable[[Any], None]) -> None:
+    """Depth-first over a jaxpr and every sub-jaxpr in eqn params
+    (scan/cond/remat/pjit bodies)."""
+    for eqn in jaxpr.eqns:
+        visit(eqn)
+        for v in eqn.params.values():
+            sub = getattr(v, "jaxpr", None)
+            if sub is not None:
+                _walk_jaxpr(sub, visit)
+            elif isinstance(v, (list, tuple)):
+                for item in v:
+                    sub = getattr(item, "jaxpr", None)
+                    if sub is not None:
+                        _walk_jaxpr(sub, visit)
+
+
+def sharding_constraint_specs(fn, *args, **kwargs) -> list:
+    """Every ``PartitionSpec`` attached to a ``with_sharding_constraint``
+    anywhere in ``fn``'s jaxpr (including scan/remat bodies)."""
+    jaxpr = jax.make_jaxpr(fn)(*args, **kwargs)
+    specs = []
+
+    def visit(eqn):
+        if eqn.primitive.name == "sharding_constraint":
+            sharding = eqn.params.get("sharding")
+            spec = getattr(sharding, "spec", None)
+            if spec is not None:
+                specs.append(spec)
+
+    _walk_jaxpr(jaxpr.jaxpr, visit)
+    return specs
+
+
+def specs_with_axis_on_dim(specs, axis: str, dim: int) -> list:
+    """Constraint specs that put mesh axis ``axis`` on tensor dim ``dim``
+    (entry == axis or a tuple containing it)."""
+    out = []
+    for spec in specs:
+        if len(spec) <= dim:
+            continue
+        entry = spec[dim]
+        if entry == axis or (isinstance(entry, tuple) and axis in entry):
+            out.append(spec)
+    return out
+
+
+def count_collectives(compiled_text: str) -> dict[str, int]:
+    """Occurrences of each collective op family in compiled HLO text —
+    the backend-independent fallback signal that GSPMD actually
+    partitioned (op mnemonics are stable across HLO dialect changes)."""
+    counts = {}
+    for name in ("all-gather", "all-reduce", "reduce-scatter",
+                 "collective-permute", "all-to-all"):
+        counts[name] = len(re.findall(rf"{name}[.\s(]", compiled_text))
+    return counts

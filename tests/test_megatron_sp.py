@@ -52,19 +52,32 @@ def test_sp_constraint_in_lowered_step(devices8):
     """The traced step carries seq-on-tensor sharding constraints on the
     residual stream, and the partitioned program gathers at block entry.
 
-    (On the CPU backend GSPMD lowers the block-exit reduce-scatter to
-    all-reduce + dynamic-slice — the reduce-scatter-creator pass is a
-    TPU/GPU optimization — so the backend-independent assertions are the
-    sdy sharding constraint and the all-gather.)"""
-    _, ad, state, data = run_tp("tp", steps=1)
-    lowered = ad._step_fn.lower(state, data.batch(0))
-    txt = lowered.as_text()
-    assert "sdy.sharding_constraint" in txt, "no sharding constraints traced"
-    assert '[{}, {"tensor"}, {}]' in txt, (
-        "residual stream is not seq-sharded on the tensor axis"
+    Structural assertions (hlo_utils): the jaxpr's sharding_constraint
+    primitives are inspected for a PartitionSpec with 'tensor' on the
+    sequence dim — no dependence on the Shardy text format — plus a
+    collective-count check on the compiled HLO.  (On the CPU backend
+    GSPMD lowers the block-exit reduce-scatter to all-reduce +
+    dynamic-slice — the reduce-scatter-creator pass is a TPU/GPU
+    optimization — so the compiled-side signal here is the all-gather.)"""
+    from hlo_utils import (
+        count_collectives,
+        sharding_constraint_specs,
+        specs_with_axis_on_dim,
     )
-    hlo = lowered.compile().as_text()
-    assert "all-gather" in hlo, "no all-gather at TP block entry"
+
+    _, ad, state, data = run_tp("tp", steps=1)
+    specs = sharding_constraint_specs(ad._step_fn, state, data.batch(0))
+    assert specs, "no sharding constraints traced into the step"
+    seq_sharded = specs_with_axis_on_dim(specs, "tensor", dim=1)
+    assert seq_sharded, (
+        f"residual stream is not seq-sharded on the tensor axis; "
+        f"constraint specs seen: {specs[:8]}"
+    )
+    hlo = ad._step_fn.lower(state, data.batch(0)).compile().as_text()
+    counts = count_collectives(hlo)
+    assert counts["all-gather"] > 0, (
+        f"no all-gather at TP block entry (collectives: {counts})"
+    )
 
 
 def test_sp_activations_seq_sharded(devices8):

@@ -239,3 +239,15 @@ def test_moe_ep_fsdp_trains(devices8):
     ad, losses = _train("ep_fsdp")
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0]
+
+
+def test_moe_ep_compile_has_no_involuntary_remat(devices8, capfd):
+    """The 8-device ep compile must be resharding-free: GSPMD's
+    "Involuntary full rematerialization" warning means the partitioner is
+    replicating-then-repartitioning expert activations every layer
+    (round-2 multichip dryrun showed this on the expert einsum backward
+    transposes until _expert_mlp pinned its intermediates).  capfd captures
+    the C++ compiler's fd-level stderr, where spmd_partitioner.cc logs."""
+    _train("ep", n_steps=1)
+    err = capfd.readouterr().err
+    assert "Involuntary full rematerialization" not in err

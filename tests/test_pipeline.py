@@ -195,6 +195,34 @@ class TestAutoDistributePipeline:
         got = make(strategy="dp", pipeline_stages=2, microbatches=2)
         np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
 
+    def test_cond_and_dense_schedules_match(self, devices8):
+        """'cond' (bubbles skip compute via lax.cond) and 'dense' (round-2
+        compute-and-mask) must be trajectory-identical: cond only removes
+        work whose results were discarded anyway."""
+        tokens = np.asarray(
+            jax.random.randint(jax.random.key(11), (8, 17), 0, 512)
+        )
+        batch = {"input_ids": tokens}
+
+        def run(sched):
+            ad = tad.AutoDistribute(
+                DecoderLM(TINY),
+                optimizer=optax.sgd(0.1),
+                loss_fn=next_token_loss,
+                strategy="dp",
+                pipeline_stages=4,
+                microbatches=2,  # S-1 > M: bubbles dominate — worst case
+                pipeline_schedule=sched,
+            )
+            state = ad.init(jax.random.key(0), batch)
+            losses = []
+            for _ in range(3):
+                state, m = ad.step(state, batch)
+                losses.append(float(m["loss"]))
+            return losses
+
+        np.testing.assert_allclose(run("cond"), run("dense"), rtol=1e-6)
+
     def test_plan_shards_layer_stack_on_pipe(self, devices8):
         ad = tad.AutoDistribute(
             DecoderLM(TINY),

@@ -56,3 +56,107 @@ def test_mesh_covers_all_devices(devices8):
     assert sorted(d.id for d in mesh.devices.flatten()) == sorted(
         d.id for d in jax.devices()
     )
+
+
+# --- hybrid ICI x DCN factorization (SURVEY.md §5 comm row) ----------------
+
+def _shapes(degrees, num_slices):
+    fact = topology.hybrid_factorization(degrees, num_slices)
+    if fact is None:
+        return None
+    ici, dcn = fact
+    return dict(zip(topology.MESH_AXES, ici)), dict(zip(topology.MESH_AXES, dcn))
+
+
+def test_hybrid_single_dcn_axis():
+    # 2 slices x 4 chips: data=8 splits into 2 across DCN x 4 in-slice
+    ici, dcn = _shapes({"data": 8}, 2)
+    assert dcn["data"] == 2 and ici["data"] == 4
+    assert all(v == 1 for k, v in dcn.items() if k != "data")
+
+
+def test_hybrid_pipe_takes_priority():
+    # 4 slices x 2 chips: pipe=4 spans DCN, tensor stays in-slice
+    ici, dcn = _shapes({"pipe": 4, "tensor": 2}, 4)
+    assert dcn["pipe"] == 4 and ici["pipe"] == 1
+    assert dcn["tensor"] == 1 and ici["tensor"] == 2
+
+
+def test_hybrid_two_axes_span_dcn():
+    # 4 slices: pipe=2 and data=2 EACH take one DCN factor (the round-2
+    # code could only put ONE axis across DCN and fell through here)
+    ici, dcn = _shapes({"pipe": 2, "data": 4, "tensor": 2}, 4)
+    assert dcn["pipe"] == 2 and dcn["data"] == 2
+    assert ici["pipe"] == 1 and ici["data"] == 2 and ici["tensor"] == 2
+
+
+def test_hybrid_partial_axis_split():
+    # 2 slices: data=4 -> 2 across DCN, 2 within each slice
+    ici, dcn = _shapes({"data": 4, "fsdp": 2}, 2)
+    assert dcn["data"] == 2 and ici["data"] == 2
+    assert dcn["fsdp"] == 1 and ici["fsdp"] == 2
+
+
+def test_hybrid_ici_axes_never_cross_slices():
+    # tensor=8 over 2 slices has no DCN-tolerant degree to span them
+    assert topology.hybrid_factorization({"tensor": 8}, 2) is None
+
+
+def test_hybrid_insufficient_dcn_degree():
+    # pipe*data = 4 cannot cover 8 slices
+    assert topology.hybrid_factorization({"pipe": 2, "data": 2}, 8) is None
+
+
+@pytest.mark.parametrize("slices,per_slice,axes", [
+    (2, 4, {"data": 8}),
+    (4, 2, {"pipe": 4, "tensor": 2}),
+    (2, 4, {"pipe": 2, "data": 2, "tensor": 2}),
+])
+def test_build_mesh_hybrid_wiring(devices8, monkeypatch, slices, per_slice, axes):
+    """build_mesh on a (simulated) multi-slice topology must route through
+    create_hybrid_device_mesh with the factorized shapes.  slice_index is
+    faked on the CPU-sim devices via detect(); the jax mesh_utils call is
+    recorded and stubbed (its internals are upstream-tested)."""
+    captured = {}
+
+    def fake_hybrid(ici_shape, dcn_shape, devices=None, **kw):
+        captured["ici"] = list(ici_shape)
+        captured["dcn"] = list(dcn_shape)
+        full = [i * d for i, d in zip(ici_shape, dcn_shape)]
+        return np.asarray(devices).reshape(full)
+
+    monkeypatch.setattr(
+        topology.mesh_utils, "create_hybrid_device_mesh", fake_hybrid
+    )
+    monkeypatch.setattr(
+        topology, "detect",
+        lambda devices=None: topology.Topology(
+            num_devices=8, num_hosts=slices, platform="cpu",
+            device_kind="cpu", num_slices=slices,
+            devices_per_slice=per_slice,
+        ),
+    )
+    mesh = tad.build_mesh(**axes)
+    assert captured, "hybrid path was not taken"
+    import math
+    assert math.prod(captured["dcn"]) == slices
+    assert math.prod(captured["ici"]) == per_slice
+    got = tad.mesh_degrees(mesh)
+    for ax, d in axes.items():
+        assert got[ax] == d
+
+
+def test_build_mesh_hybrid_fallthrough_warns(devices8, monkeypatch):
+    """When the DCN-tolerant degrees cannot cover the slice count the
+    fall-through to a flat mesh must be LOUD (round-2 weak #3: it was
+    silent)."""
+    monkeypatch.setattr(
+        topology, "detect",
+        lambda devices=None: topology.Topology(
+            num_devices=8, num_hosts=2, platform="cpu", device_kind="cpu",
+            num_slices=2, devices_per_slice=4,
+        ),
+    )
+    with pytest.warns(UserWarning, match="FLAT device mesh"):
+        mesh = tad.build_mesh(tensor=8)
+    assert tad.mesh_degrees(mesh)["tensor"] == 8

@@ -93,6 +93,10 @@ class AutoDistribute:
         moments — 10 B/param) | 'bf16' (all-bf16 storage — 8 B/param), or a
         ``training.precision.Precision``.  Update math is always fp32; the
         planner's HBM model accounts for the chosen dtypes.
+    pipeline_schedule:
+        'cond' (default; bubble iterations skip their stage compute via a
+        per-device lax.cond) | 'dense' (compute-everything-and-mask).
+        Trajectory-identical; see parallel/pipeline.py.
     """
 
     def __init__(
@@ -112,6 +116,7 @@ class AutoDistribute:
         seq_impl: str = "auto",
         pipeline_stages: int = 1,
         microbatches: int = 8,
+        pipeline_schedule: str = "cond",
         precision: str | precision_mod.Precision = "fp32",
     ):
         if model is None and init_fn is None:
@@ -139,6 +144,7 @@ class AutoDistribute:
             raise ValueError("pipeline_stages and seq_parallel are exclusive (v1)")
         self._pipeline_stages = pipeline_stages
         self._microbatches = microbatches
+        self._pipeline_schedule = pipeline_schedule
         self._pipelined_apply = None
         self._pctx = None
         self.plan: planner_mod.ShardPlan | None = None
@@ -212,6 +218,7 @@ class AutoDistribute:
                 self.plan.mesh,
                 n_microbatches=self._microbatches,
                 remat=self._remat,
+                schedule=self._pipeline_schedule,
             )
             self.plan.remat = False
         return self.plan

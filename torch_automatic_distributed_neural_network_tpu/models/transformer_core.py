@@ -85,7 +85,7 @@ class TransformerConfig:
         return L * (attn + mlp) + norms + emb + pos
 
 
-def make_norm(cfg: TransformerConfig, name: str):
+def make_norm(cfg: TransformerConfig, name: str | None = None):
     if cfg.norm == "rmsnorm":
         return nn.RMSNorm(epsilon=1e-5, dtype=cfg.dtype, name=name)
     return nn.LayerNorm(epsilon=1e-5, dtype=cfg.dtype, name=name)
@@ -104,47 +104,68 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 
 
 class SelfAttention(nn.Module):
+    """setup()-style so the decode path (inference/decode.py) can apply
+    the q/k/v and output projections piecewise (``method='qkv'`` /
+    ``method='out_proj'``) against a KV cache — ONE implementation of the
+    projection + rope math for train and decode."""
+
     cfg: TransformerConfig
 
-    @nn.compact
-    def __call__(self, x, positions, mask=None):
+    def setup(self):
         cfg = self.cfg
         hd = cfg.head_dim
-        dense = lambda feats, name: nn.DenseGeneral(
-            feats, axis=-1, dtype=cfg.dtype, name=name, use_bias=cfg.norm == "layernorm"
+        bias = cfg.norm == "layernorm"
+        dense = lambda feats: nn.DenseGeneral(
+            feats, axis=-1, dtype=cfg.dtype, use_bias=bias
         )
-        q = dense((cfg.n_heads, hd), "q_proj")(x)
-        k = dense((cfg.kv_heads, hd), "k_proj")(x)
-        v = dense((cfg.kv_heads, hd), "v_proj")(x)
+        self.q_proj = dense((cfg.n_heads, hd))
+        self.k_proj = dense((cfg.kv_heads, hd))
+        self.v_proj = dense((cfg.kv_heads, hd))
+        self.o_proj = nn.DenseGeneral(
+            cfg.d_model, axis=(-2, -1), dtype=cfg.dtype, use_bias=bias
+        )
+
+    def qkv(self, x, positions):
+        """Projected (and rope-rotated) q/k/v for a chunk at ``positions``."""
+        cfg = self.cfg
+        q, k, v = self.q_proj(x), self.k_proj(x), self.v_proj(x)
         if cfg.pos == "rope":
             q = rope(q, positions, cfg.rope_theta)
             k = rope(k, positions, cfg.rope_theta)
-        out = attention(q, k, v, causal=True, mask=mask, impl=cfg.attention_impl)
-        return nn.DenseGeneral(
-            cfg.d_model, axis=(-2, -1), dtype=cfg.dtype, name="o_proj",
-            use_bias=cfg.norm == "layernorm",
-        )(out)
+        return q, k, v
+
+    def out_proj(self, out):
+        return self.o_proj(out)
+
+    def __call__(self, x, positions, mask=None):
+        q, k, v = self.qkv(x, positions)
+        out = attention(
+            q, k, v, causal=True, mask=mask, impl=self.cfg.attention_impl
+        )
+        return self.out_proj(out)
 
 
 class MLPBlock(nn.Module):
+    """setup()-style so decode applies it directly on cached-path chunks
+    — the gelu/SwiGLU feed-forward math lives here and only here."""
+
     cfg: TransformerConfig
 
-    @nn.compact
-    def __call__(self, x):
+    def setup(self):
         cfg = self.cfg
         bias = cfg.norm == "layernorm"
+        dense = lambda feats: nn.Dense(feats, dtype=cfg.dtype, use_bias=bias)
         if cfg.act == "swiglu":
-            gate = nn.Dense(cfg.ff_dim, dtype=cfg.dtype, use_bias=bias,
-                            name="gate_proj")(x)
-            up = nn.Dense(cfg.ff_dim, dtype=cfg.dtype, use_bias=bias,
-                          name="up_proj")(x)
-            h = nn.silu(gate) * up
+            self.gate_proj = dense(cfg.ff_dim)
+        self.up_proj = dense(cfg.ff_dim)
+        self.down_proj = dense(cfg.d_model)
+
+    def __call__(self, x):
+        if self.cfg.act == "swiglu":
+            h = nn.silu(self.gate_proj(x)) * self.up_proj(x)
         else:
-            h = nn.Dense(cfg.ff_dim, dtype=cfg.dtype, use_bias=bias,
-                         name="up_proj")(x)
-            h = nn.gelu(h)
-        return nn.Dense(cfg.d_model, dtype=cfg.dtype, use_bias=bias,
-                        name="down_proj")(h)
+            h = nn.gelu(self.up_proj(x))
+        return self.down_proj(h)
 
 
 class DecoderLayer(nn.Module):

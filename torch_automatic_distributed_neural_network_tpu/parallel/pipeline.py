@@ -34,9 +34,14 @@ own key from the step rng, so the pattern is schedule-independent and
 deterministic under resume.
 
 Schedule cost: ``M + S - 1`` iterations for M microbatches on S stages;
-bubble fraction ``(S-1)/(M+S-1)``.  Every rank computes every iteration
-(bubble iterations compute on garbage and are masked out) — uniform SPMD
-compute, which is what keeps this a single XLA program.
+bubble fraction ``(S-1)/(M+S-1)`` of the *iterations*.  With the default
+``schedule='cond'`` a per-device ``lax.cond`` skips the stage computation
+on bubble iterations (HLO conditionals are runtime control flow even in
+SPMD programs — each pipe rank takes its own branch, and the tensor/data
+auto-axis peers of a rank agree on the predicate, so collectives inside
+the taken branch stay consistent).  ``schedule='dense'`` keeps the
+round-2 compute-everything-and-mask behavior for A/B measurement
+(bench.py mode=pipeline records the gap).
 """
 
 from __future__ import annotations
@@ -67,6 +72,7 @@ def spmd_pipeline(
     *,
     n_stages: int,
     axis_name: str = "pipe",
+    schedule: str = "cond",
 ) -> jax.Array:
     """GPipe microbatch loop.  MUST run inside `shard_map` manual over
     ``axis_name`` with ``stage_params`` sharded on it (leading dim) and
@@ -77,7 +83,26 @@ def spmd_pipeline(
     id, for rng folding); activation shape/dtype must be preserved
     (transformer blocks are).  Returns ``[M, mb, ...]`` outputs,
     replicated along ``axis_name``.
+
+    ``schedule`` picks how bubble iterations are handled:
+
+    - ``'cond'`` (default) — a per-device ``lax.cond`` skips the stage
+      computation entirely when the iteration is a bubble for this rank
+      (stage s works on microbatch t-s; warmup/drain iterations outside
+      [0, M) pass the activation through untouched).  The HLO conditional
+      is real runtime control flow, so bubble FLOPs (and their backward)
+      are never executed — the (S-1)/(M+S-1) fraction of compute the
+      dense schedule burned on garbage.
+    - ``'dense'`` — the round-2 behavior: every rank computes every
+      iteration and bubble results are masked out.  Kept for A/B
+      measurement (bench.py mode=pipeline) and as a fallback.
+
+    Both schedules run the same ``M + S - 1`` iterations and are
+    trajectory-identical (the parity test pins them); 'cond' only removes
+    work whose results were already discarded.
     """
+    if schedule not in ("cond", "dense"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
     S = n_stages
     M = microbatches.shape[0]
     stage = jax.lax.axis_index(axis_name)
@@ -118,7 +143,17 @@ def spmd_pipeline(
             ),
             act,
         )
-        out = checked_stage(stage_params, inp, mb_idx)
+        if schedule == "cond":
+            # real work iff 0 <= t - stage < M; bubbles pass through
+            work = jnp.logical_and(t - stage >= 0, t - stage < M)
+            out = jax.lax.cond(
+                work,
+                lambda a: checked_stage(stage_params, a, mb_idx),
+                lambda a: a,
+                inp,
+            )
+        else:
+            out = checked_stage(stage_params, inp, mb_idx)
         # the last stage finishes microbatch t-(S-1) at iteration t
         out_idx = jnp.clip(t - (S - 1), 0, M - 1)
         is_done = jnp.logical_and(stage == S - 1, t >= S - 1)
@@ -155,6 +190,7 @@ def make_pipelined_apply(
     n_microbatches: int = 8,
     axis_name: str = "pipe",
     remat: bool | None = None,
+    schedule: str = "cond",
 ) -> Callable:
     """Build ``apply(variables, tokens, rngs=...) -> logits`` running
     ``model``'s layer stack as a GPipe pipeline over ``mesh``'s ``pipe``
@@ -256,7 +292,7 @@ def make_pipelined_apply(
         )):
             out = spmd_pipeline(
                 make_stage_fn(key_data), layer_params, mbs,
-                n_stages=S, axis_name=axis_name,
+                n_stages=S, axis_name=axis_name, schedule=schedule,
             )
         return out.reshape(x.shape)  # fp32 across the region boundary
 

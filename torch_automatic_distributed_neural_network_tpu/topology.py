@@ -32,6 +32,7 @@ from __future__ import annotations
 import dataclasses
 import math
 import os
+import warnings
 from typing import Mapping, Sequence
 
 import jax
@@ -146,6 +147,37 @@ def _resolve_degrees(
     return full
 
 
+def hybrid_factorization(
+    degrees: Mapping[str, int], num_slices: int
+) -> tuple[list[int], list[int]] | None:
+    """Split every mesh-axis degree into (in-slice, cross-slice) factors.
+
+    Greedy gcd over the DCN-tolerant axes in MESH_AXES order: ``pipe``
+    absorbs as much of the slice count as divides it, then ``data`` takes
+    the rest — so BOTH may span DCN at once (e.g. 4 slices with pipe=2,
+    data=2x in-slice batch).  ICI-critical axes (tensor/seq/expert/fsdp)
+    never cross slices.  Returns ``(ici_shape, dcn_shape)`` ordered like
+    MESH_AXES, or None when the DCN-tolerant degrees cannot cover the
+    slice count (caller falls back to a flat mesh, loudly).
+    """
+    dcn_shape: list[int] = []
+    ici_shape: list[int] = []
+    remaining = num_slices
+    for ax in MESH_AXES:
+        d = int(degrees.get(ax, 1))
+        if ax in DCN_OK_AXES and remaining > 1:
+            g = math.gcd(d, remaining)
+            dcn_shape.append(g)
+            ici_shape.append(d // g)
+            remaining //= g
+        else:
+            dcn_shape.append(1)
+            ici_shape.append(d)
+    if remaining != 1:
+        return None
+    return ici_shape, dcn_shape
+
+
 def build_mesh(
     *,
     data: int | None = None,
@@ -183,21 +215,10 @@ def build_mesh(
     shape = tuple(degrees[ax] for ax in MESH_AXES)
 
     if topo.is_multislice and topo.devices_per_slice:
-        # Hybrid mesh: DCN-tolerant axes across slices, the rest within.
-        per_slice = topo.devices_per_slice
-        dcn_shape = []
-        ici_shape = []
-        remaining_dcn = topo.num_slices
-        for ax in MESH_AXES:
-            d = degrees[ax]
-            if ax in DCN_OK_AXES and remaining_dcn > 1 and d % remaining_dcn == 0:
-                dcn_shape.append(remaining_dcn)
-                ici_shape.append(d // remaining_dcn)
-                remaining_dcn = 1
-            else:
-                dcn_shape.append(1)
-                ici_shape.append(d)
-        if remaining_dcn == 1 and math.prod(ici_shape) == per_slice * 1:
+        fact = hybrid_factorization(degrees, topo.num_slices)
+        if fact is not None:
+            ici_shape, dcn_shape = fact
+            assert math.prod(ici_shape) == topo.devices_per_slice
             dev_array = mesh_utils.create_hybrid_device_mesh(
                 ici_shape,
                 dcn_shape,
@@ -205,7 +226,19 @@ def build_mesh(
                 allow_split_physical_axes=allow_split_physical_axes,
             )
             return Mesh(dev_array, MESH_AXES)
-        # Fall through to flat mesh if the factorization failed.
+        # Loud fall-through: a flat mesh on a multi-slice topology puts
+        # ICI-critical collectives on DCN — legal but slow, and the user
+        # should know why and how to fix the axis degrees.
+        warnings.warn(
+            f"Cannot factor mesh axes {dict(degrees)} so that the "
+            f"DCN-tolerant axes {DCN_OK_AXES} cover {topo.num_slices} "
+            f"slices (their combined degree must be divisible by the "
+            f"slice count). Falling back to a FLAT device mesh: "
+            f"tensor/fsdp/expert collectives may cross DCN and be "
+            f"slow. Raise the pipe/data degrees to a multiple of the "
+            f"slice count to get a hybrid ICIxDCN mesh.",
+            stacklevel=2,
+        )
 
     try:
         dev_array = mesh_utils.create_device_mesh(
