@@ -365,7 +365,9 @@ def bench_attention(args):
 
             grad = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
             g = grad(q, k, v)  # compile
-            jax.block_until_ready(g)
+            # host readback fence: block_until_ready does NOT synchronize
+            # on the tunneled axon TPU (see readback_overhead_s)
+            float(jnp.sum(g[0][0, 0, 0]))
             overhead = readback_overhead_s()
             iters = 20 if seq <= 2048 else 10
             t0 = time.perf_counter()
@@ -421,6 +423,209 @@ def _cpu_sim_reexec(n_devices=8, note=""):
         raise RuntimeError(f"CPU-sim bench failed:\n{proc.stderr[-2000:]}")
     print(proc.stdout, end="", flush=True)
     raise SystemExit(0)
+
+
+def bench_decode(args):
+    """Decode throughput (inference/decode.py): prefill tokens/s and
+    per-token decode tokens/s at batch 1 and 8 (VERDICT r2 missing #5).
+
+    Method: ``generate(max_new_tokens=1)`` times prefill (+1 step);
+    ``generate(max_new_tokens=1+N)`` minus that isolates N cached decode
+    steps.  Both executables are warmed before timing; the axon readback
+    overhead is subtracted once per measurement.
+    """
+    import jax
+    import numpy as np
+    import optax
+
+    import torch_automatic_distributed_neural_network_tpu as tad
+    from torch_automatic_distributed_neural_network_tpu.data.synthetic import (
+        SyntheticLM,
+    )
+    from torch_automatic_distributed_neural_network_tpu.models import (
+        GPT2,
+        gpt2_config,
+    )
+    from torch_automatic_distributed_neural_network_tpu.training import (
+        next_token_loss,
+    )
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        size = args["model"] if args["model"] in (
+            "small", "medium") else "small"
+        prompt_len, new_tokens = 512, 256
+    else:
+        # CPU sim: the 124M model's 256-step decode scan grinds for tens
+        # of minutes — smoke-test the machinery at test scale instead.
+        size, prompt_len, new_tokens = "test", 128, 64
+        log("mode=decode: CPU sim -> model=test prefill=128 decode=64")
+    mcfg = gpt2_config(size, max_seq_len=prompt_len + new_tokens + 1)
+    log(f"bench: decode GPT-2 {size} ({mcfg.num_params()/1e6:.0f}M) "
+        f"prefill={prompt_len} decode={new_tokens}")
+    data = SyntheticLM(vocab_size=mcfg.vocab_size, seq_len=prompt_len + 1,
+                       batch_size=8)
+    ad = tad.AutoDistribute(
+        GPT2(size, max_seq_len=prompt_len + new_tokens + 1),
+        optimizer=optax.adamw(1e-4),
+        loss_fn=next_token_loss,
+        strategy="dp",
+    )
+    state = ad.init(jax.random.key(0), data.batch(0))
+
+    rows = []
+    for batch in (1, 8):
+        prompt = np.asarray(data.batch(0)["input_ids"])[:batch, :prompt_len]
+        prompt = jax.numpy.asarray(prompt, dtype=jax.numpy.int32)
+
+        def timed_generate(n_new, iters=3):
+            out = ad.generate(state, prompt, max_new_tokens=n_new)
+            np.asarray(out)  # warm: trace + compile + run (host readback fence)
+            overhead = readback_overhead_s()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = ad.generate(state, prompt, max_new_tokens=n_new)
+            np.asarray(out)  # ONE fence for the whole chain
+            # overhead is one readback per MEASUREMENT, not per iteration
+            return max(
+                (time.perf_counter() - t0 - overhead) / iters, 1e-9
+            )
+
+        t_prefill = timed_generate(1)
+        t_full = timed_generate(1 + new_tokens)
+        t_decode = max(t_full - t_prefill, 1e-9)
+        prefill_tps = batch * prompt_len / t_prefill
+        decode_tps = batch * new_tokens / t_decode
+        rows.append({
+            "batch": batch,
+            "prefill_ms": round(t_prefill * 1e3, 1),
+            "prefill_tokens_per_s": round(prefill_tps, 1),
+            "decode_tokens_per_s": round(decode_tps, 1),
+            "decode_ms_per_token": round(t_decode * 1e3 / new_tokens, 3),
+        })
+        log(f"decode batch={batch}: prefill {prefill_tps:,.0f} tok/s "
+            f"({t_prefill*1e3:.0f}ms), decode {decode_tps:,.0f} tok/s "
+            f"({t_decode*1e3/new_tokens:.1f}ms/tok)")
+
+    return {
+        "metric": f"gpt2_{size}_decode_tokens_per_sec_batch8",
+        "value": rows[-1]["decode_tokens_per_s"],
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,
+        "extra": {"rows": rows, "prompt_len": prompt_len,
+                  "new_tokens": new_tokens, "params_m":
+                  round(mcfg.num_params() / 1e6),
+                  "backend": jax.default_backend()},
+    }
+
+
+def bench_checkpoint(args):
+    """Checkpoint save/restore wall time + step-time impact (VERDICT r2
+    next #10).  The Orbax wrapper saves async (CheckpointManager enables
+    it); measured here: (a) save() call latency — the device->host copy
+    the train loop actually blocks on, (b) full drain (wait()), (c)
+    restore, (d) step time in the shadow of an in-flight save vs
+    baseline — the number that proves async saving doesn't stall steps.
+    """
+    import os
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+    import optax
+
+    import torch_automatic_distributed_neural_network_tpu as tad
+    from torch_automatic_distributed_neural_network_tpu.data.synthetic import (
+        SyntheticLM,
+    )
+    from torch_automatic_distributed_neural_network_tpu.models import (
+        GPT2,
+        gpt2_config,
+    )
+    from torch_automatic_distributed_neural_network_tpu.training import (
+        CheckpointManager,
+        next_token_loss,
+    )
+    from torch_automatic_distributed_neural_network_tpu.training.checkpoint import (
+        abstract_state_for,
+    )
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        size = args["model"] if args["model"] in (
+            "test", "small", "medium", "large", "1p3b") else "1p3b"
+        seq, batch = args["seq"], args["batch"]
+    else:
+        # CPU sim: a 14.7 GiB 1.3B state would grind for hours — always
+        # use the test model; the TPU run records the real 1.3B numbers.
+        size, seq, batch = "test", 64, 8
+        log("mode=checkpoint: CPU sim -> forcing model=test")
+    mcfg = gpt2_config(size, max_seq_len=seq)
+    data = SyntheticLM(vocab_size=mcfg.vocab_size, seq_len=seq + 1,
+                       batch_size=batch)
+    ad = tad.AutoDistribute(
+        GPT2(size, max_seq_len=seq,
+             remat_policy=args["remat_policy"]),
+        optimizer=optax.adamw(1e-4),
+        loss_fn=next_token_loss,
+        strategy=args["strategy"],
+        precision=args["precision"] if on_tpu else "fp32",
+    )
+    state = ad.init(jax.random.key(0), data.batch(0))
+    state, m = ad.step(state, data.batch(0))
+    float(m["loss"])
+    state_bytes = sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree.leaves(state)
+        if hasattr(leaf, "size")
+    )
+    log(f"checkpoint bench: GPT-2 {size} state {state_bytes/2**30:.2f} GiB")
+
+    # baseline step time (no checkpoint in flight)
+    batches = [data.batch(i) for i in range(10)]
+    state, dt_base = timed_chain(ad.step, state, batches)
+
+    ckpt_dir = tempfile.mkdtemp(prefix="tadnn_ckpt_bench_")
+    try:
+        mngr = CheckpointManager(ckpt_dir)
+        t0 = time.perf_counter()
+        mngr.save(int(state.step), state)
+        t_save_call = time.perf_counter() - t0
+        # steps in the shadow of the in-flight async save
+        state, dt_shadow = timed_chain(ad.step, state, batches)
+        t0 = time.perf_counter()
+        mngr.wait()
+        t_drain = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        abstract = abstract_state_for(ad, jax.random.key(0), data.batch(0))
+        restored = mngr.restore(abstract)
+        jax.block_until_ready(restored.params)
+        t_restore = time.perf_counter() - t0
+        mngr.close()
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+    spike = dt_shadow / dt_base if dt_base > 0 else float("inf")
+    log(f"save() call {t_save_call*1e3:.0f}ms, drain {t_drain*1e3:.0f}ms, "
+        f"restore {t_restore*1e3:.0f}ms; step {dt_base*1e3:.1f}ms -> "
+        f"{dt_shadow*1e3:.1f}ms during save ({spike:.2f}x)")
+    return {
+        "metric": "checkpoint_step_time_spike_during_save",
+        "value": round(spike, 3),
+        "unit": "x",
+        "vs_baseline": 0.0,
+        "extra": {
+            "model": size,
+            "state_gib": round(state_bytes / 2**30, 3),
+            "save_call_ms": round(t_save_call * 1e3, 1),
+            "drain_ms": round(t_drain * 1e3, 1),
+            "restore_ms": round(t_restore * 1e3, 1),
+            "step_ms_baseline": round(dt_base * 1e3, 2),
+            "step_ms_during_save": round(dt_shadow * 1e3, 2),
+            "backend": jax.default_backend(),
+        },
+    }
 
 
 def bench_pipeline(args):
@@ -573,7 +778,8 @@ def main():
     args = parse_args()
     fn = {"gpt2": bench_gpt2, "resnet": bench_resnet, "moe": bench_moe,
           "collectives": bench_collectives, "overlap": bench_overlap,
-          "attention": bench_attention, "pipeline": bench_pipeline}[args["mode"]]
+          "attention": bench_attention, "pipeline": bench_pipeline,
+          "decode": bench_decode, "checkpoint": bench_checkpoint}[args["mode"]]
     result = fn(args)
     print(json.dumps(result), flush=True)
 

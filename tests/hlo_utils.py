@@ -70,5 +70,9 @@ def count_collectives(compiled_text: str) -> dict[str, int]:
     counts = {}
     for name in ("all-gather", "all-reduce", "reduce-scatter",
                  "collective-permute", "all-to-all"):
-        counts[name] = len(re.findall(rf"{name}[.\s(]", compiled_text))
+        # '-start' covers the async forms TPU/GPU HLO emits
+        # (all-gather-start/-done); '-done' is not counted separately so
+        # each async collective still counts once.
+        counts[name] = len(re.findall(rf"{name}(-start)?[.\s(]",
+                                      compiled_text))
     return counts

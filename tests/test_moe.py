@@ -241,6 +241,64 @@ def test_moe_ep_fsdp_trains(devices8):
     assert losses[-1] < losses[0]
 
 
+def test_moe_ep_tp_matches_single_device(devices8):
+    """ep_tp (Mixtral layout): experts on the expert axis AND each expert
+    Megatron-split on tensor (MOE_TP_RULES).  Parity vs 1-device oracle
+    at the reduction-order tolerance (5e-4, like the ring tests): the
+    tensor-split down projection psums partial sums in a different order
+    under bf16 compute.  The expert banks must carry both axes."""
+    _, single = _train("dp", devices=jax.devices()[:1])
+    ad, eptp = _train("ep_tp")
+    d = tad.mesh_degrees(ad.plan.mesh)
+    assert ad.plan.strategy == "ep_tp"
+    assert d["expert"] > 1 and d["tensor"] > 1
+    flat = jax.tree_util.tree_flatten_with_path(
+        ad.plan.param_specs, is_leaf=lambda x: isinstance(x, P)
+    )[0]
+    bank_specs = {
+        "/".join(str(getattr(k, "key", k)) for k in path): spec
+        for path, spec in flat
+        if "experts_" in "/".join(str(getattr(k, "key", k)) for k in path)
+    }
+    assert bank_specs, "no expert banks found"
+    for path, spec in bank_specs.items():
+        flat_axes = [
+            ax for dim in spec
+            for ax in (dim if isinstance(dim, tuple) else (dim,)) if ax
+        ]
+        assert "expert" in flat_axes and "tensor" in flat_axes, (path, spec)
+    np.testing.assert_allclose(eptp, single, rtol=5e-4, atol=5e-4)
+
+
+def test_moe_ep_tp_keeps_room_for_tensor(devices8):
+    """E=8 on 8 devices: a plain gcd would eat every device for experts;
+    ep_tp halves the expert degree so the Megatron split is real
+    (expert=4 x tensor=2), instead of silently degenerating to pure ep."""
+    data = SyntheticLM(vocab_size=256, seq_len=33, batch_size=8)
+    ad = tad.AutoDistribute(
+        MoE("test", vocab_size=256, max_seq_len=32, n_experts=8),
+        optimizer=optax.adamw(1e-3),
+        loss_fn=moe_next_token_loss,
+        strategy="ep_tp",
+    )
+    plan = ad.build_plan(jax.random.key(0), data.batch(0))
+    d = tad.mesh_degrees(plan.mesh)
+    assert d["expert"] == 4 and d["tensor"] == 2, d
+
+
+def test_moe_ep_with_context_parallel(devices8):
+    """EP x CP (README composition matrix): ring/Ulysses attention over
+    the seq axis composes with expert dispatch (which is seq-local after
+    routing).  Parity tolerance matches the other ring-attention tests
+    (5e-4: fp32 softmax accumulation order differs across the KV ring
+    under bf16 compute)."""
+    _, single = _train("dp", devices=jax.devices()[:1])
+    ad, epcp = _train("ep", seq_parallel=2)
+    d = tad.mesh_degrees(ad.plan.mesh)
+    assert d["expert"] > 1 and d["seq"] == 2
+    np.testing.assert_allclose(epcp, single, rtol=5e-4, atol=5e-4)
+
+
 def test_moe_ep_compile_has_no_involuntary_remat(devices8, capfd):
     """The 8-device ep compile must be resharding-free: GSPMD's
     "Involuntary full rematerialization" warning means the partitioner is

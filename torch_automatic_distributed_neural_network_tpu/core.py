@@ -79,8 +79,10 @@ class AutoDistribute:
     init_fn:
         ``(rng, batch) -> params`` — overrides ``model.init``.
     strategy:
-        'auto' | 'dp' | 'fsdp' | 'tp' | 'tp_fsdp'.  'auto' picks from model
-        size vs HBM (planner.choose_strategy).
+        'auto' | 'dp' | 'fsdp' | 'tp' | 'tp_fsdp' | 'ep' | 'ep_fsdp' |
+        'ep_tp' (MoE: experts on the expert axis, each expert
+        Megatron-split on tensor).  'auto' picks from model size vs HBM
+        (planner.choose_strategy).
     mesh:
         Explicit ``jax.sharding.Mesh``; built from strategy if omitted.
     remat:
@@ -141,7 +143,25 @@ class AutoDistribute:
         self._seq_parallel = seq_parallel
         self._seq_impl = seq_impl
         if pipeline_stages > 1 and seq_parallel > 1:
-            raise ValueError("pipeline_stages and seq_parallel are exclusive (v1)")
+            # Design constraint, not a TODO: context parallelism is a
+            # manual-collective path (ring/Ulysses shard_map over 'seq')
+            # and the pipeline trunk is already a partial-manual shard_map
+            # over 'pipe' whose stages force the einsum attention path
+            # (a nested manual region over a second axis inside a scanned,
+            # differentiated stage loop buys nothing: pipe already slices
+            # activations M-fold, so per-stage HBM is bounded by
+            # microbatching, which is the same memory lever CP provides).
+            # Composition matrix: README.md "Strategy composition".
+            raise ValueError(
+                "pipeline_stages > 1 cannot be combined with "
+                "seq_parallel > 1: context parallelism (ring/Ulysses) and "
+                "the pipeline trunk are both manual-collective regions. "
+                "For long sequences under a pipeline, raise `microbatches` "
+                "(bounds per-stage activation memory the same way CP "
+                "would) or drop the pipeline and use seq_parallel with "
+                "fsdp/tensor (planner strategies 'cp', 'tp'+seq). See the "
+                "strategy-composition matrix in README.md."
+            )
         self._pipeline_stages = pipeline_stages
         self._microbatches = microbatches
         self._pipeline_schedule = pipeline_schedule
@@ -486,6 +506,23 @@ class AutoDistribute:
                     cache_dtype=cache_dtype, mesh=mesh,
                 )
 
+            # Small decode batches (e.g. batch 1 on an 8-device mesh)
+            # cannot shard on the batch axes — jit input shardings need
+            # divisibility.  Replicate the prompt then; the internal KV
+            # constraints still place heads on the tensor axis.
+            import math
+
+            batch_sharding = self.plan.batch_sharding()
+            n_batch = math.prod(
+                n for ax, n in topo_mod.mesh_degrees(mesh).items()
+                if any(
+                    ax in (e if isinstance(e, tuple) else (e,))
+                    for e in batch_sharding.spec if e is not None
+                )
+            )
+            b = getattr(prompt, "shape", (0,))[0]
+            if n_batch > 1 and b % n_batch:
+                batch_sharding = NamedSharding(mesh, P())
             cached[key] = jax.jit(
                 run,
                 in_shardings=(
@@ -494,7 +531,7 @@ class AutoDistribute:
                         self.plan.param_specs,
                         is_leaf=lambda x: isinstance(x, P),
                     ),
-                    self.plan.batch_sharding(),
+                    batch_sharding,
                     None,
                 ),
             )

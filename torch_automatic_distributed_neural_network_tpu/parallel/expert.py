@@ -106,23 +106,29 @@ def top_k_routing(
 
 def expert_mlp(h_in: jax.Array, w_up, w_gate, w_down,
                act: Callable[[jax.Array], jax.Array],
-               constrain: Callable[[jax.Array], jax.Array] = lambda t: t,
+               constrain_hidden: Callable[[jax.Array], jax.Array] = lambda t: t,
+               constrain_out: Callable[[jax.Array], jax.Array] = lambda t: t,
                ) -> jax.Array:
     """Per-expert FFN on dispatched tokens: [..., E, C, d] -> [..., E, C, d].
 
     Einsum keeps the E dim explicit so the planner can shard it; the
     contraction dims land on the MXU as one batched matmul per expert.
-    ``constrain`` pins every einsum output to the dispatched layout —
-    without it GSPMD's sharding propagation invents transient layouts on
+    The constraints pin every einsum output to the dispatched layout —
+    without them GSPMD's sharding propagation invents transient layouts on
     the backward transposes and logs "Involuntary full rematerialization"
     (observed on the 8-device moe/ep compile, VERDICT round 2 weak #2).
+    They differ under ep_tp: the hidden [..., E, C, f] carries the f dim
+    on ``tensor`` (Megatron column split inside each expert), while the
+    output [..., E, C, d] is tensor-replicated (the down contraction
+    psums over tensor).
     """
-    h = constrain(jnp.einsum("...ecd,edf->...ecf", h_in, w_up))
+    h = constrain_hidden(jnp.einsum("...ecd,edf->...ecf", h_in, w_up))
     if w_gate is not None:
-        h = act(constrain(jnp.einsum("...ecd,edf->...ecf", h_in, w_gate))) * h
+        h = act(constrain_hidden(
+            jnp.einsum("...ecd,edf->...ecf", h_in, w_gate))) * h
     else:
         h = act(h)
-    return constrain(jnp.einsum("...ecf,efd->...ecd", h, w_down))
+    return constrain_out(jnp.einsum("...ecf,efd->...ecd", h, w_down))
 
 
 def moe_ffn(
@@ -152,26 +158,38 @@ def moe_ffn(
 
     compute_dtype = x.dtype
     h = jnp.einsum("bsec,bsd->becd", dispatch.astype(compute_dtype), x)
-    constrain = lambda t: t
+    constrain_hidden = constrain_out = lambda t: t
     if mesh is not None:
         degrees = dict(zip(mesh.axis_names, mesh.devices.shape))
         if degrees.get(expert_axis, 1) > 1:
             # [B, E, C, *]: batch stays on the data axes, experts move to
             # the expert axis -> GSPMD inserts the all_to_all pair here
-            # and at the combine einsum below.  The same constraint is
-            # applied to every expert-MLP intermediate (see expert_mlp)
-            # so the 8-device layout stays consistent through fwd AND the
-            # backward weight-grad transposes.
+            # and at the combine einsum below.  Constraints on every
+            # expert-MLP intermediate (see expert_mlp) keep the 8-device
+            # layout consistent through fwd AND the backward weight-grad
+            # transposes.  Under ep_tp (MOE_TP_RULES) the hidden f dim
+            # additionally rides the tensor axis.
             present = tuple(
                 a for a in batch_axes
                 if a != expert_axis and degrees.get(a, 1) > 1
             )
-            sharding = jax.sharding.NamedSharding(
+            out_sharding = jax.sharding.NamedSharding(
                 mesh, P(present or None, expert_axis)
             )
-            constrain = lambda t: jax.lax.with_sharding_constraint(t, sharding)
-            h = constrain(h)
-    h = expert_mlp(h, w_up, w_gate, w_down, act, constrain)
+            tensor_split = (
+                degrees.get("tensor", 1) > 1
+                and w_up.shape[-1] % degrees["tensor"] == 0
+            )
+            hidden_sharding = jax.sharding.NamedSharding(
+                mesh, P(present or None, expert_axis, None, "tensor")
+            ) if tensor_split else out_sharding
+            constrain_out = lambda t: jax.lax.with_sharding_constraint(
+                t, out_sharding)
+            constrain_hidden = lambda t: jax.lax.with_sharding_constraint(
+                t, hidden_sharding)
+            h = constrain_out(h)
+    h = expert_mlp(h, w_up, w_gate, w_down, act,
+                   constrain_hidden, constrain_out)
     y = jnp.einsum("bsec,becd->bsd", combine.astype(compute_dtype), h)
     return y.astype(x.dtype), metrics
 

@@ -85,6 +85,18 @@ MOE_RULES: tuple[Rule, ...] = (
     Rule(r"router/", ()),
 )
 
+# ep_tp (Mixtral-style EP x TP): experts on the ``expert`` axis AND each
+# expert Megatron-split on ``tensor`` — fan-out banks [E, d, f] column-
+# split the f dim, the fan-in bank [E, f, d] row-splits it; the down
+# contraction then reduces over tensor (GSPMD psum), exactly the dense
+# Megatron pattern per expert.
+MOE_TP_RULES: tuple[Rule, ...] = (
+    Rule(r"(experts?_(up|gate)|expert_bank|moe_w\d)[^/]*$",
+         ("expert", None, "tensor")),
+    Rule(r"experts?_down[^/]*$", ("expert", "tensor", None)),
+    Rule(r"router/", ()),
+)
+
 
 # ---------------------------------------------------------------------------
 # Plan
@@ -249,7 +261,8 @@ def param_spec_tree(
     §7 phase 3).
     """
     degrees = topo_mod.mesh_degrees(mesh)
-    use_tp = strategy in ("tp", "tp_fsdp") and degrees.get("tensor", 1) > 1
+    use_tp = (strategy in ("tp", "tp_fsdp", "ep_tp")
+              and degrees.get("tensor", 1) > 1)
     use_fsdp = (
         strategy in ("fsdp", "tp_fsdp", "ep_fsdp")
         and _axis_size(fsdp_axes, degrees) > 1
@@ -283,7 +296,7 @@ def param_spec_tree(
                 entries[0] = "pipe"
             spec = _norm_spec(entries)
         if spec is None and use_ep:
-            for rule in MOE_RULES:
+            for rule in (MOE_TP_RULES if use_tp else MOE_RULES):
                 if rule.matches(path):
                     spec = _spec_from_rule(rule, shape, degrees)
                     break
@@ -438,10 +451,10 @@ def make_plan(
     chosen/requested strategy.  ``pipe`` > 1 adds a pipeline axis; layer
     stacks shard their leading dim onto it (parallel/pipeline.py).
     """
-    known = ("auto", "dp", "fsdp", "tp", "tp_fsdp", "ep", "ep_fsdp")
+    known = ("auto", "dp", "fsdp", "tp", "tp_fsdp", "ep", "ep_fsdp", "ep_tp")
     if strategy not in known:
         raise ValueError(f"Unknown strategy {strategy!r}; expected one of {known}")
-    if pipe > 1 and strategy in ("ep", "ep_fsdp"):
+    if pipe > 1 and strategy in ("ep", "ep_fsdp", "ep_tp"):
         raise ValueError(
             "pipeline parallelism composes with dp/fsdp/tp (v2); "
             f"strategy {strategy!r} + pipe={pipe} is not supported"
@@ -451,7 +464,12 @@ def make_plan(
     if mesh is None:
         n = topo.num_devices
         if seq > 1 and pipe > 1:
-            raise ValueError("seq-parallel + pipeline in one plan: not yet")
+            raise ValueError(
+                "seq-parallel + pipeline in one plan is a design "
+                "constraint (both are manual-collective regions); raise "
+                "microbatches for per-stage memory, or use seq without "
+                "pipe — README strategy-composition matrix"
+            )
         if pipe > 1:
             if n % pipe:
                 raise ValueError(
@@ -471,7 +489,16 @@ def make_plan(
                 rules, state_factor=state_factor,
             )
             if pipe > 1 and resolved in ("ep", "ep_fsdp"):
-                # pp x expert-parallel is not wired; fall back to fsdp
+                import warnings
+
+                warnings.warn(
+                    f"auto strategy chose {resolved!r} but pipeline "
+                    f"parallelism does not compose with expert parallelism "
+                    f"(README strategy-composition matrix); falling back "
+                    f"to 'fsdp' — the expert banks shard on the fsdp axis "
+                    f"instead of having their own all_to_all dispatch",
+                    stacklevel=2,
+                )
                 resolved, degrees = "fsdp", {"fsdp": n}
         elif strategy == "dp":
             degrees = {"data": n}
@@ -487,7 +514,7 @@ def make_plan(
             while t > 2 and n // t < 2:
                 t //= 2
             degrees = {"fsdp": n // t, "tensor": t}
-        elif strategy in ("ep", "ep_fsdp"):
+        elif strategy in ("ep", "ep_fsdp", "ep_tp"):
             e_count = detect_expert_count(abstract_params)
             if not e_count:
                 raise ValueError(
@@ -503,8 +530,30 @@ def make_plan(
                     "possible on this device count; use fsdp/dp or change "
                     "the device count / expert count"
                 )
-            degrees = {"expert": e,
-                       ("data" if strategy == "ep" else "fsdp"): n // e}
+            if strategy == "ep_tp":
+                # keep room for a nontrivial tensor axis: halve the expert
+                # degree (still divides n and e_count) until >=2 devices
+                # remain for tensor
+                rem = n // e
+                while rem < 2 and e > 1 and e % 2 == 0:
+                    e //= 2
+                    rem = n // e
+                if rem < 2 and n > 1:
+                    import warnings
+
+                    warnings.warn(
+                        f"strategy 'ep_tp': {n} devices leave no room for "
+                        f"a tensor axis next to expert={e} — degenerating "
+                        f"to pure 'ep' (no per-expert Megatron split)",
+                        stacklevel=2,
+                    )
+                t = min(8, rem)
+                while rem % t:
+                    t //= 2
+                degrees = {"expert": e, "tensor": t, "data": rem // t}
+            else:
+                degrees = {"expert": e,
+                           ("data" if strategy == "ep" else "fsdp"): n // e}
         else:
             raise ValueError(f"Unknown strategy {strategy!r}")
         if seq > 1:
@@ -528,7 +577,10 @@ def make_plan(
         if strategy == "auto":
             d = topo_mod.mesh_degrees(mesh)
             if d.get("expert", 1) > 1:
-                resolved = "ep_fsdp" if d.get("fsdp", 1) > 1 else "ep"
+                if d.get("tensor", 1) > 1:
+                    resolved = "ep_tp"
+                else:
+                    resolved = "ep_fsdp" if d.get("fsdp", 1) > 1 else "ep"
             elif d.get("tensor", 1) > 1 and d.get("fsdp", 1) > 1:
                 resolved = "tp_fsdp"
             elif d.get("tensor", 1) > 1:
@@ -540,7 +592,8 @@ def make_plan(
 
     param_specs = param_spec_tree(abstract_params, mesh, resolved, rules)
     degrees_final = topo_mod.mesh_degrees(mesh)
-    if resolved in ("tp", "tp_fsdp") and degrees_final.get("tensor", 1) > 1:
+    if resolved in ("tp", "tp_fsdp", "ep_tp") and degrees_final.get(
+            "tensor", 1) > 1:
         sharded = any(
             "tensor" in (ax for dim in spec for ax in
                          (dim if isinstance(dim, tuple) else (dim,)) if ax)
