@@ -160,6 +160,47 @@ class TestPipelinedApply:
             np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
         )
 
+    def test_custom_positions_and_mask_thread_through_stages(self, devices8):
+        """Round-2 gap: pipelined apply raised NotImplementedError on
+        custom positions/mask.  Now they replicate into the region and
+        each stage indexes its microbatch's slice — parity with plain
+        model.apply on a rope model with a padding mask."""
+        mesh = _mesh(devices8[:2], (2,), ("pipe",))
+        cfg = TransformerConfig(
+            vocab_size=256, d_model=64, n_layers=4, n_heads=4,
+            max_seq_len=64, norm="rmsnorm", act="swiglu", pos="rope",
+            dtype=jnp.float32,
+        )
+        model = DecoderLM(cfg)
+        B, S = 4, 16
+        tokens = jax.random.randint(jax.random.key(0), (B, S), 0, 256)
+        # shifted positions (as in packed/continued sequences) + padding
+        # mask hiding the last 3 keys of every row
+        positions = jnp.broadcast_to(jnp.arange(S)[None, :] + 5, (B, S))
+        mask = jnp.broadcast_to(
+            (jnp.arange(S) < S - 3)[None, None, None, :], (B, 1, 1, S)
+        )
+        variables = model.init(jax.random.key(1), tokens)
+        ref = model.apply(variables, tokens, positions, mask)
+        papply = pipeline.make_pipelined_apply(model, mesh, n_microbatches=2)
+        out = jax.jit(papply)(variables, tokens, positions, mask)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+        # broadcastable extras (leading dim 1) work like plain apply
+        out_b = jax.jit(papply)(
+            variables, tokens, positions[:1], mask[:1]
+        )
+        np.testing.assert_allclose(
+            np.asarray(out_b), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+        # and the default path (no extras) still matches
+        ref0 = model.apply(variables, tokens)
+        out0 = jax.jit(papply)(variables, tokens)
+        np.testing.assert_allclose(
+            np.asarray(out0), np.asarray(ref0), atol=2e-5, rtol=2e-5
+        )
+
     def test_rejects_indivisible_layers(self, devices8):
         mesh = _mesh(devices8[:4], (4,), ("pipe",))
         cfg = TransformerConfig(

@@ -142,3 +142,29 @@ def test_bad_strategy_rejected_with_explicit_mesh(devices8):
     with pytest.raises(ValueError, match="strategy"):
         planner.make_plan(transformer_like_params(), mesh=mesh,
                           strategy="fspd")
+
+
+def test_ep_tp_moe_rules_w2_is_fan_in(devices8):
+    """MOE_TP_RULES must row-split the fan-in banks (experts_down AND the
+    w1/w2/w3-convention moe_w2) and column-split the fan-out ones; banks
+    of unknown orientation get expert-only sharding (round-3 review fix:
+    moe_w2 was matching the column-split rule first)."""
+    mesh = tad.build_mesh(expert=4, tensor=2)
+    params = {
+        "mlp": {
+            "experts_up": Shape(4, 64, 256),
+            "experts_down": Shape(4, 256, 64),
+            "moe_w1": Shape(4, 64, 256),
+            "moe_w2": Shape(4, 256, 64),
+            "moe_w7": Shape(4, 64, 256),
+            "router": {"kernel": Shape(64, 4)},
+        }
+    }
+    specs = planner.param_spec_tree(params, mesh, "ep_tp")
+    mlp = specs["mlp"]
+    assert mlp["experts_up"] == P("expert", None, "tensor")
+    assert mlp["experts_down"] == P("expert", "tensor")  # trailing None trimmed
+    assert mlp["moe_w1"] == P("expert", None, "tensor")
+    assert mlp["moe_w2"] == P("expert", "tensor")
+    assert mlp["moe_w7"] == P("expert")  # unknown orientation: E dim only
+    assert mlp["router"]["kernel"] == P()

@@ -99,6 +99,14 @@ class AutoDistribute:
         'cond' (default; bubble iterations skip their stage compute via a
         per-device lax.cond) | 'dense' (compute-everything-and-mask).
         Trajectory-identical; see parallel/pipeline.py.
+    grad_accum:
+        Accumulate gradients over this many sequential slices of every
+        batch before the (single) optimizer update — train with k x the
+        batch that fits in HBM.  A ``lax.scan`` inside the same jitted
+        step: one compiled program, grads averaged in compute dtype,
+        dropout rng folded per slice.  Stateful models (BatchNorm) update
+        their statistics per slice, sequentially — the same semantics as
+        torch-style accumulation loops.
     """
 
     def __init__(
@@ -120,6 +128,7 @@ class AutoDistribute:
         microbatches: int = 8,
         pipeline_schedule: str = "cond",
         precision: str | precision_mod.Precision = "fp32",
+        grad_accum: int = 1,
     ):
         if model is None and init_fn is None:
             raise ValueError("Provide a model or an init_fn")
@@ -165,10 +174,15 @@ class AutoDistribute:
         self._pipeline_stages = pipeline_stages
         self._microbatches = microbatches
         self._pipeline_schedule = pipeline_schedule
+        if grad_accum < 1:
+            raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
+        self._grad_accum = grad_accum
         self._pipelined_apply = None
         self._pctx = None
         self.plan: planner_mod.ShardPlan | None = None
         self._step_fn = None
+        self._eval_fn = None
+        self._state_shardings = None
         self._apply_fn = model.apply if model is not None else None
         self._has_model_state = False
 
@@ -319,26 +333,37 @@ class AutoDistribute:
             for ax in axes if isinstance(axes, tuple) else (axes,):
                 if ax:
                     dp *= degrees.get(ax, 1)
-        if dp <= 1 and self._pipeline_stages <= 1:
+        accum = self._grad_accum
+        if dp <= 1 and self._pipeline_stages <= 1 and accum <= 1:
             return
         for leaf in jax.tree.leaves(batch):
             shape = getattr(leaf, "shape", ())
             if not shape:
                 continue  # scalar batch entries are replicated, not split
             n = shape[0]
-            if n is not None and n % dp:
+            if n is None:
+                continue
+            if accum > 1 and n % accum:
                 raise ValueError(
-                    f"Global batch size {n} is not divisible by the "
-                    f"data-parallel degree {dp} (mesh {degrees}). Increase "
-                    f"the batch size or reduce the data/fsdp mesh axes."
+                    f"Global batch size {n} is not divisible by "
+                    f"grad_accum={accum}."
+                )
+            sliced = n // accum
+            if sliced % dp:
+                raise ValueError(
+                    f"Global batch size {n}"
+                    + (f" / grad_accum={accum} = {sliced}" if accum > 1
+                       else "")
+                    + f" is not divisible by the data-parallel degree {dp} "
+                    f"(mesh {degrees}). Increase the batch size or reduce "
+                    f"the data/fsdp mesh axes."
                 )
             if (
-                n is not None
-                and self._pipeline_stages > 1
-                and (n // dp) % self._microbatches
+                self._pipeline_stages > 1
+                and (sliced // dp) % self._microbatches
             ):
                 raise ValueError(
-                    f"Per-device batch {n // dp} is not divisible by "
+                    f"Per-device batch {sliced // dp} is not divisible by "
                     f"microbatches={self._microbatches} (pipeline). Adjust "
                     "batch size or microbatches."
                 )
@@ -367,6 +392,7 @@ class AutoDistribute:
     def _compile_step(self, state_abstract, shardings):
         plan = self.plan
         assert plan is not None
+        self._state_shardings = shardings  # eval_step reuses these
         batch_sharding = plan.batch_sharding()
 
         from .parallel import context as pctx
@@ -384,16 +410,19 @@ class AutoDistribute:
         def traced_step(state: TrainState, batch):
             step_rng = jax.random.fold_in(state.rng, state.step)
 
-            def loss_inner(p):
-                return self._loss_for(p, state.model_state, batch, step_rng)
+            def slice_grads(params, model_state, mb, rng):
+                def loss_inner(p):
+                    return self._loss_for(p, model_state, mb, rng)
 
-            if plan.remat:
-                # Gradient checkpointing (C7): recompute everything but
-                # matmul outputs in the backward pass.
-                loss_inner = jax.checkpoint(
-                    loss_inner,
-                    policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
-                )
+                if plan.remat:
+                    # Gradient checkpointing (C7): recompute everything
+                    # but matmul outputs in the backward pass.
+                    loss_inner = jax.checkpoint(
+                        loss_inner,
+                        policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+                    )
+                return jax.value_and_grad(loss_inner, has_aux=True)(params)
+
             # Mixed precision: differentiate w.r.t. the compute-dtype cast
             # of the master params, so the whole gradient tree materializes
             # in compute_dtype (half the HBM of fp32 grads); the optimizer
@@ -403,8 +432,53 @@ class AutoDistribute:
                 if cast_for_compute
                 else state.params
             )
-            grad_fn = jax.value_and_grad(loss_inner, has_aux=True)
-            (loss, aux), grads = grad_fn(compute_params)
+            k = self._grad_accum
+            if k == 1:
+                (loss, aux), grads = slice_grads(
+                    compute_params, state.model_state, batch, step_rng
+                )
+            else:
+                # Gradient accumulation: scan k sequential batch slices in
+                # ONE compiled program.  The [B, ...] -> [k, B/k, ...]
+                # reshape keeps the (smaller) batch dim sharded on the
+                # data axes (constrained explicitly so GSPMD never guesses
+                # the split dim); model_state threads sequentially.
+                def reslice(x):
+                    x = jnp.asarray(x)
+                    if x.ndim < 1:
+                        # scalar batch entries replicate to every slice
+                        return jnp.broadcast_to(x, (k,))
+                    y = x.reshape((k, x.shape[0] // k) + x.shape[1:])
+                    return jax.lax.with_sharding_constraint(
+                        y, NamedSharding(
+                            plan.mesh, P(None, *plan.batch_spec)
+                        )
+                    )
+
+                mbs = jax.tree.map(reslice, batch)
+
+                def accum_body(carry, xs):
+                    g_acc, loss_acc, ms = carry
+                    i, mb = xs
+                    (loss_i, aux_i), g_i = slice_grads(
+                        compute_params, ms,
+                        mb, jax.random.fold_in(step_rng, i),
+                    )
+                    new_ms = aux_i.pop("model_state", ms)
+                    g_acc = jax.tree.map(jnp.add, g_acc, g_i)
+                    return (g_acc, loss_acc + loss_i, new_ms), aux_i
+
+                g0 = jax.tree.map(jnp.zeros_like, compute_params)
+                (grads, loss, ms_final), aux_stack = jax.lax.scan(
+                    accum_body,
+                    (g0, jnp.zeros((), jnp.float32), state.model_state),
+                    (jnp.arange(k), mbs),
+                )
+                grads = jax.tree.map(lambda g: g / k, grads)
+                loss = loss / k
+                aux = jax.tree.map(lambda a: jnp.mean(a, axis=0), aux_stack)
+                if self._has_model_state:
+                    aux["model_state"] = ms_final
             updates, opt_state = self.optimizer.update(
                 grads, state.opt_state, state.params
             )
@@ -438,6 +512,44 @@ class AutoDistribute:
         if jax.process_count() > 1:
             batch = self.shard_batch(batch)
         return self._step_fn(state, batch)
+
+    def eval_step(self, state: TrainState, batch) -> dict:
+        """Forward-only loss/metrics, deterministic: the training loss_fn
+        with ``rng=None`` (the shipped losses then pass no dropout rng, so
+        dropout is off) and no optimizer update.  Stateful models
+        (BatchNorm) evaluate with batch statistics; their running stats
+        are NOT updated.  Jitted once with the plan's shardings.
+        """
+        assert self._step_fn is not None, "call init() first"
+        if self._eval_fn is None:
+            from .parallel import context as pctx
+
+            prec = self.precision
+            cast = np.dtype(prec.compute_dtype) != np.dtype(prec.param_dtype)
+
+            def eval_fn(state: TrainState, batch):
+                with pctx.use(self._pctx):
+                    params = (
+                        precision_mod.cast_floats(
+                            state.params, prec.compute_dtype
+                        ) if cast else state.params
+                    )
+                    loss, aux = self._loss_for(
+                        params, state.model_state, batch, None
+                    )
+                    aux = dict(aux)
+                    aux.pop("model_state", None)
+                    return {"loss": loss, **aux}
+
+            self._eval_fn = jax.jit(
+                eval_fn,
+                in_shardings=(
+                    self._state_shardings, self.plan.batch_sharding()
+                ),
+            )
+        if jax.process_count() > 1:
+            batch = self.shard_batch(batch)
+        return self._eval_fn(state, batch)
 
     # -- inference ----------------------------------------------------------
 

@@ -230,8 +230,8 @@ def make_pipelined_apply(
 
     layer = DecoderLayer(cfg)
 
-    def one_layer(p, x, positions, rngs):
-        return layer.apply({"params": p}, x, positions, rngs=rngs)
+    def one_layer(p, x, positions, mask, rngs):
+        return layer.apply({"params": p}, x, positions, mask, rngs=rngs)
 
     if cfg.remat if remat is None else remat:
         one_layer = jax.checkpoint(
@@ -243,13 +243,30 @@ def make_pipelined_apply(
             ),
         )
 
-    def make_stage_fn(key_data):
+    def make_stage_fn(key_data, positions_mbs=None, mask_mbs=None):
+        """``positions_mbs``/``mask_mbs`` are the custom per-token
+        positions / attention mask pre-split to ``[M, mb, ...]`` and
+        replicated into the region; each stage indexes its current
+        microbatch's slice by ``mb_idx`` (they never hop with the
+        activation — every stage holds the full copy)."""
+
         def stage_fn(stage_params, x, mb_idx):
             # fp32 in/out: activations and their cotangents cross every
             # stage hop and the region boundary in fp32 (see pipe_region);
             # compute inside the stage stays in the model dtype
             x = x.astype(cfg.dtype)
-            positions = jnp.arange(x.shape[1])[None, :]
+            if positions_mbs is None:
+                positions = jnp.arange(x.shape[1])[None, :]
+            else:
+                positions = jax.lax.dynamic_index_in_dim(
+                    positions_mbs, mb_idx, 0, keepdims=False
+                )
+            mask = (
+                None if mask_mbs is None
+                else jax.lax.dynamic_index_in_dim(
+                    mask_mbs, mb_idx, 0, keepdims=False
+                )
+            )
             stage = jax.lax.axis_index(axis_name)
 
             def body(carry, xs):
@@ -265,7 +282,7 @@ def make_pipelined_apply(
                     rngs = {"dropout": key}
                 else:
                     rngs = None
-                return one_layer(p, carry, positions, rngs), None
+                return one_layer(p, carry, positions, mask, rngs), None
 
             y, _ = jax.lax.scan(
                 body, x, (stage_params, jnp.arange(L_local))
@@ -276,33 +293,48 @@ def make_pipelined_apply(
 
     from . import context as pctx
 
-    def pipe_region(layer_params, x, key_data):
-        b = x.shape[0]
-        if b % M:
-            raise ValueError(
-                f"batch {b} not divisible by {M} microbatches"
-            )
-        mbs = x.reshape((M, b // M) + x.shape[1:])
-        # Inside the region: manual over pipe, auto over everything else.
-        # Mesh-axis sharding constraints are disabled (they would name
-        # auto axes from inside a manual region) and attention is forced
-        # to the einsum path, which GSPMD partitions over the auto axes.
-        with pctx.use(pctx.ParallelContext(
-            mesh=mesh, enable_constraints=False, attn_impl="xla",
-        )):
-            out = spmd_pipeline(
-                make_stage_fn(key_data), layer_params, mbs,
-                n_stages=S, axis_name=axis_name, schedule=schedule,
-            )
-        return out.reshape(x.shape)  # fp32 across the region boundary
+    def _split_mb(t, b):
+        return t.reshape((M, b // M) + t.shape[1:])
 
-    pipe = shard_map(
-        pipe_region,
-        mesh=mesh,
-        in_specs=(P(axis_name), P(), P()),
-        out_specs=P(),
-        axis_names={axis_name},
-    )
+    @functools.lru_cache(maxsize=None)
+    def make_pipe(has_pos: bool, has_mask: bool):
+        """shard_map'd pipeline region for the given extra-input shape
+        (custom positions and/or attention mask: replicated [B, ...]
+        arrays split to [M, mb, ...] and indexed per microbatch)."""
+
+        def pipe_region(layer_params, x, key_data, *extras):
+            b = x.shape[0]
+            if b % M:
+                raise ValueError(
+                    f"batch {b} not divisible by {M} microbatches"
+                )
+            it = iter(extras)
+            positions_mbs = _split_mb(next(it), b) if has_pos else None
+            mask_mbs = _split_mb(next(it), b) if has_mask else None
+            mbs = _split_mb(x, b)
+            # Inside the region: manual over pipe, auto over everything
+            # else.  Mesh-axis sharding constraints are disabled (they
+            # would name auto axes from inside a manual region) and
+            # attention is forced to the einsum path, which GSPMD
+            # partitions over the auto axes.
+            with pctx.use(pctx.ParallelContext(
+                mesh=mesh, enable_constraints=False, attn_impl="xla",
+            )):
+                out = spmd_pipeline(
+                    make_stage_fn(key_data, positions_mbs, mask_mbs),
+                    layer_params, mbs,
+                    n_stages=S, axis_name=axis_name, schedule=schedule,
+                )
+            return out.reshape(x.shape)  # fp32 across the region boundary
+
+        n_extras = int(has_pos) + int(has_mask)
+        return shard_map(
+            pipe_region,
+            mesh=mesh,
+            in_specs=(P(axis_name), P(), P()) + (P(),) * n_extras,
+            out_specs=P(),
+            axis_names={axis_name},
+        )
 
     embed = nn.Embed(
         cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
@@ -310,11 +342,11 @@ def make_pipelined_apply(
     )
 
     def apply(variables, tokens, positions=None, mask=None, rngs=None):
-        if positions is not None or mask is not None:
-            raise NotImplementedError(
-                "pipelined apply does not thread custom positions/mask "
-                "through stages yet — use default causal attention"
-            )
+        # Custom positions/mask thread through stages: replicated into the
+        # region, split to [M, mb, ...], indexed by microbatch id (they
+        # never ride the ppermute ring).  mask must be per-batch-row
+        # boolean [B, 1|H, Q, K] (ops/attention convention); the causal
+        # mask itself stays implicit in the attention op.
         dropout_key = (rngs or {}).get("dropout")
         if cfg.dropout_rate and dropout_key is None:
             raise ValueError(
@@ -335,7 +367,15 @@ def make_pipelined_apply(
         # AllReducePromotion pass (reducer contains a Sharding custom-call
         # it cannot clone), and fp32 residual transport across stage hops
         # is numerically conservative anyway.  Stage compute stays bf16.
-        x = pipe(params["layers"], x.astype(jnp.float32), key_data)
+        pipe = make_pipe(positions is not None, mask is not None)
+        # plain model.apply accepts broadcastable extras (leading dim 1);
+        # the microbatch split needs the full batch dim — broadcast first
+        B = tokens.shape[0]
+        extras = tuple(
+            jnp.broadcast_to(e, (B,) + e.shape[1:])
+            for e in (positions, mask) if e is not None
+        )
+        x = pipe(params["layers"], x.astype(jnp.float32), key_data, *extras)
         x = x.astype(cfg.dtype)
         x = make_norm(cfg, "final_norm").apply(
             {"params": params["final_norm"]}, x

@@ -91,9 +91,14 @@ MOE_RULES: tuple[Rule, ...] = (
 # contraction then reduces over tensor (GSPMD psum), exactly the dense
 # Megatron pattern per expert.
 MOE_TP_RULES: tuple[Rule, ...] = (
-    Rule(r"(experts?_(up|gate)|expert_bank|moe_w\d)[^/]*$",
+    # fan-in first: 'down' banks and the w2 of the w1/w2/w3 convention
+    # ([E, f, d]) row-split — contraction dim f on tensor
+    Rule(r"(experts?_down|moe_w2)[^/]*$", ("expert", "tensor", None)),
+    # fan-out ([E, d, f]) column-split — output dim f on tensor
+    Rule(r"(experts?_(up|gate)|expert_bank|moe_w[13])[^/]*$",
          ("expert", None, "tensor")),
-    Rule(r"experts?_down[^/]*$", ("expert", "tensor", None)),
+    # unknown-orientation banks: expert axis only (the MOE_RULES layout)
+    Rule(r"moe_w\d[^/]*$", ("expert", None, None)),
     Rule(r"router/", ()),
 )
 

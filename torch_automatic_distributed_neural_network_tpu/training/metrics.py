@@ -121,6 +121,27 @@ class MetricsLogger:
             print("  ".join(parts), file=sys.stderr)
         return record
 
+    def log_eval(self, step: int, metrics: dict) -> dict:
+        """Write an evaluation record: plain fields only — no step-time /
+        throughput / MFU math (those are meaningless for an eval pass and
+        would corrupt consumers averaging the training records)."""
+        record: dict[str, Any] = {"step": step, "time": time.time()}
+        for k, v in metrics.items():
+            try:
+                record[k] = float(v)
+            except (TypeError, ValueError):
+                pass
+        if self._file:
+            self._file.write(json.dumps(record) + "\n")
+            self._file.flush()
+        if self.console:
+            parts = [f"step {step:5d}"] + [
+                f"{k} {v:.4f}" for k, v in record.items()
+                if k not in ("step", "time")
+            ]
+            print("  ".join(parts), file=sys.stderr)
+        return record
+
     def close(self) -> None:
         if self._file:
             self._file.close()

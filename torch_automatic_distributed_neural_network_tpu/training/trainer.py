@@ -43,6 +43,8 @@ class TrainerConfig:
     divergence_every: int = 0  # 0 = off; N = check params hash every N
     watchdog_timeout_s: float = 0.0  # 0 = off; stall detector (elastic.py)
     heartbeat_dir: str = ""  # "" = off; shared-dir liveness beats
+    eval_every: int = 0  # 0 = off; run evaluate(eval_data) every N steps
+    eval_batches: int = 8  # batches per periodic evaluation
 
 
 class Trainer:
@@ -56,6 +58,7 @@ class Trainer:
         items_per_step: int | None = None,
         run_config: dict | None = None,
         callbacks: "list[Callable[[int, TrainState, dict], None]] | None" = None,
+        eval_data: Any = None,
     ):
         self.ad = ad
         self.cfg = cfg
@@ -64,6 +67,42 @@ class Trainer:
         self.items_per_step = items_per_step
         self.run_config = run_config
         self.callbacks = list(callbacks or [])
+        self.eval_data = eval_data
+
+    def evaluate(
+        self, data: Any, n_batches: int, *, state: "TrainState",
+    ) -> dict:
+        """Mean forward-only metrics over ``n_batches`` of ``data``
+        (step-indexed source or iterable) using ``ad.eval_step`` —
+        deterministic (no dropout), no optimizer/state mutation."""
+        indexed = getattr(data, "step_indexed", False) and callable(
+            getattr(data, "batch", None)
+        )
+        it = None if indexed else iter(data)
+        totals: dict[str, float] = {}
+        n = 0
+        for i in range(n_batches):
+            try:
+                batch = data.batch(i) if indexed else next(it)
+            except StopIteration:
+                break
+            m = self.ad.eval_step(state, batch)
+            for k, v in m.items():
+                try:
+                    totals[k] = totals.get(k, 0.0) + float(v)
+                except (TypeError, ValueError):
+                    pass
+            n += 1
+        if n == 0:
+            import warnings
+
+            warnings.warn(
+                "evaluate() got no batches — a one-shot eval_data "
+                "iterator is exhausted; pass a step-indexed source or a "
+                "re-iterable so periodic eval keeps data",
+                stacklevel=2,
+            )
+        return {f"eval_{k}": v / max(n, 1) for k, v in totals.items()}
 
     def fit(
         self,
@@ -148,6 +187,18 @@ class Trainer:
                         )
                 if cfg.divergence_every and i % cfg.divergence_every == 0:
                     self._guard_divergence(state, i)
+                if (
+                    cfg.eval_every and self.eval_data is not None
+                    and (i + 1) % cfg.eval_every == 0
+                ):
+                    ev = self.evaluate(
+                        self.eval_data, cfg.eval_batches, state=state
+                    )
+                    if self.metrics:
+                        self.metrics.log_eval(i + 1, ev)
+                    elif jax.process_index() == 0:
+                        print(f"step {i + 1} " + "  ".join(
+                            f"{k} {v:.4f}" for k, v in ev.items()))
                 if (
                     self.ckpt and cfg.ckpt_every
                     and (i + 1) % cfg.ckpt_every == 0
