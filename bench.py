@@ -774,8 +774,51 @@ def bench_collectives(args):
     }
 
 
+def _probe_backend(timeout_s: int = 300) -> str | None:
+    """Initialize the JAX backend in a SUBPROCESS with a timeout.
+
+    The tunneled axon TPU backend can hang ``jax.devices()`` indefinitely
+    when the tunnel is down (observed 2026-07-29: 24-minute hang, then
+    'UNAVAILABLE: TPU backend setup/compile error') — and the hang is in
+    a C call, so no in-process alarm can break it.  Returns an error
+    string when the backend is unreachable, None when it is fine.
+    """
+    import os
+    import subprocess
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return None  # CPU sim never hangs
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].device_kind)"],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return f"backend init hung > {timeout_s}s (tunnel down?)"
+    if proc.returncode != 0:
+        return proc.stderr.strip().splitlines()[-1][:300] if (
+            proc.stderr.strip()) else f"backend init rc={proc.returncode}"
+    return None
+
+
 def main():
     args = parse_args()
+    err = _probe_backend()
+    if err is not None:
+        # Emit an honest, parseable record instead of hanging the driver:
+        # the metric is unmeasurable this run, and the record says why.
+        log(f"TPU backend unreachable: {err}")
+        print(json.dumps({
+            "metric": f"{args['mode']}_unmeasurable_backend_down",
+            "value": 0.0,
+            "unit": "none",
+            "vs_baseline": 0.0,
+            "extra": {"error": err, "mode": args["mode"],
+                      "note": ("TPU tunnel was down at bench time; "
+                               "see BENCH_NOTES.md for committed runs")},
+        }), flush=True)
+        return
     fn = {"gpt2": bench_gpt2, "resnet": bench_resnet, "moe": bench_moe,
           "collectives": bench_collectives, "overlap": bench_overlap,
           "attention": bench_attention, "pipeline": bench_pipeline,

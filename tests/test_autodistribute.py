@@ -162,6 +162,37 @@ def test_grad_accum_stateful_model(devices8):
     assert losses[-1] < losses[0]
 
 
+def test_grad_accum_count_metrics_sum_not_average(devices8):
+    """Count-like aux metrics ('tokens') keep full-batch semantics under
+    accumulation: summed over slices, not averaged (ratio metrics like
+    accuracy stay averaged)."""
+    import optax as _optax
+
+    from torch_automatic_distributed_neural_network_tpu.data.synthetic import (
+        SyntheticLM,
+    )
+    from torch_automatic_distributed_neural_network_tpu.models import GPT2
+    from torch_automatic_distributed_neural_network_tpu.training import (
+        next_token_loss,
+    )
+
+    data = SyntheticLM(vocab_size=64, seq_len=9, batch_size=16)
+
+    def tokens_metric(grad_accum):
+        ad = tad.AutoDistribute(
+            GPT2("test", vocab_size=64, max_seq_len=8),
+            optimizer=_optax.sgd(0.1),
+            loss_fn=next_token_loss,
+            strategy="dp",
+            grad_accum=grad_accum,
+        )
+        state = ad.init(jax.random.key(0), data.batch(0))
+        _, m = ad.step(state, data.batch(0))
+        return float(m["tokens"])
+
+    assert tokens_metric(2) == tokens_metric(1) == 16 * 8
+
+
 def test_grad_accum_divisibility_error(devices8):
     ad = make_ad("dp", grad_accum=3)
     with pytest.raises(ValueError, match="grad_accum"):

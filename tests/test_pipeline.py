@@ -392,15 +392,57 @@ class TestPipelineV2:
         assert not np.allclose(np.asarray(a), np.asarray(c))
         assert np.isfinite(np.asarray(a)).all()
 
-    def test_dropout_requires_rng(self, devices8):
+    def test_dropout_rng_optional_missing_means_off(self, devices8):
+        """flax missing-rng convention (round-3: replaced the old
+        ValueError): no dropout key -> deterministic pass, matching plain
+        model.apply without rngs — what eval_step relies on; passing a
+        key actually drops (output differs)."""
         cfg = TransformerConfig(
             vocab_size=64, d_model=32, n_layers=2, n_heads=2,
-            max_seq_len=16, dropout_rate=0.1,
+            max_seq_len=16, dropout_rate=0.5, dtype=jnp.float32,
         )
         mesh = _mesh(devices8[:2], (2,), ("pipe",))
         model = DecoderLM(cfg)
-        tokens = jnp.zeros((2, 8), jnp.int32)
+        tokens = jax.random.randint(jax.random.key(2), (2, 8), 0, 64)
         variables = model.init(jax.random.key(0), tokens)
         papply = pipeline.make_pipelined_apply(model, mesh, n_microbatches=2)
-        with pytest.raises(ValueError, match="dropout"):
-            papply(variables, tokens)
+        det = jax.jit(papply)(variables, tokens)
+        ref = model.apply(variables, tokens)  # no rngs -> dropout off
+        np.testing.assert_allclose(
+            np.asarray(det), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+        dropped = jax.jit(papply)(
+            variables, tokens, rngs={"dropout": jax.random.key(7)}
+        )
+        assert not np.allclose(np.asarray(dropped), np.asarray(det))
+
+    def test_dropout_trains_under_default_cond_schedule(self, devices8):
+        """Regression: the 'cond' schedule with dropout rngs trips a JAX
+        cond-partial-eval internal assertion under AD (branch-asymmetric
+        PRNG residuals) — the pipeline must auto-downgrade dropout models
+        to 'dense'.  This trains (grad, not just forward) and evals."""
+        import optax
+
+        from torch_automatic_distributed_neural_network_tpu.data.synthetic import (
+            SyntheticLM,
+        )
+        from torch_automatic_distributed_neural_network_tpu.training import (
+            next_token_loss,
+        )
+
+        cfg = TransformerConfig(
+            vocab_size=128, d_model=32, n_layers=2, n_heads=2,
+            max_seq_len=16, dropout_rate=0.1, dtype=jnp.float32,
+        )
+        data = SyntheticLM(vocab_size=128, seq_len=17, batch_size=8)
+        ad = tad.AutoDistribute(
+            DecoderLM(cfg), optimizer=optax.sgd(0.1),
+            loss_fn=next_token_loss, strategy="dp",
+            pipeline_stages=2, microbatches=2,  # default schedule: cond
+        )
+        state = ad.init(jax.random.key(0), data.batch(0))
+        state, m = ad.step(state, data.batch(0))
+        assert np.isfinite(float(m["loss"]))
+        e1 = ad.eval_step(state, data.batch(1))
+        e2 = ad.eval_step(state, data.batch(1))
+        assert float(e1["loss"]) == float(e2["loss"])  # dropout off in eval

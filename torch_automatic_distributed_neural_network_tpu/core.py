@@ -476,7 +476,17 @@ class AutoDistribute:
                 )
                 grads = jax.tree.map(lambda g: g / k, grads)
                 loss = loss / k
-                aux = jax.tree.map(lambda a: jnp.mean(a, axis=0), aux_stack)
+                # Ratio metrics (accuracy, aux_loss) average over slices;
+                # COUNT metrics keep full-batch semantics by summing.
+                # Convention: keys named 'tokens'/'items' or ending in
+                # '_count' are counts (training/losses.py follows it).
+                aux = {
+                    key: (jnp.sum(v, axis=0)
+                          if key in ("tokens", "items")
+                          or key.endswith("_count")
+                          else jnp.mean(v, axis=0))
+                    for key, v in aux_stack.items()
+                }
                 if self._has_model_state:
                     aux["model_state"] = ms_final
             updates, opt_state = self.optimizer.update(

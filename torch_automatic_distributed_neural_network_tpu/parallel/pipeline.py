@@ -243,12 +243,15 @@ def make_pipelined_apply(
             ),
         )
 
-    def make_stage_fn(key_data, positions_mbs=None, mask_mbs=None):
+    def make_stage_fn(key_data, positions_mbs=None, mask_mbs=None,
+                      use_dropout=True):
         """``positions_mbs``/``mask_mbs`` are the custom per-token
         positions / attention mask pre-split to ``[M, mb, ...]`` and
         replicated into the region; each stage indexes its current
         microbatch's slice by ``mb_idx`` (they never hop with the
-        activation — every stage holds the full copy)."""
+        activation — every stage holds the full copy).  ``use_dropout``
+        False = deterministic pass (eval): no dropout rngs are threaded,
+        matching the flax missing-rng convention."""
 
         def stage_fn(stage_params, x, mb_idx):
             # fp32 in/out: activations and their cotangents cross every
@@ -271,7 +274,7 @@ def make_pipelined_apply(
 
             def body(carry, xs):
                 p, li = xs
-                if cfg.dropout_rate:
+                if cfg.dropout_rate and use_dropout:
                     # schedule-independent key: one stream per
                     # (microbatch, global layer) pair
                     base = jax.random.wrap_key_data(key_data)
@@ -297,7 +300,7 @@ def make_pipelined_apply(
         return t.reshape((M, b // M) + t.shape[1:])
 
     @functools.lru_cache(maxsize=None)
-    def make_pipe(has_pos: bool, has_mask: bool):
+    def make_pipe(has_pos: bool, has_mask: bool, use_dropout: bool = True):
         """shard_map'd pipeline region for the given extra-input shape
         (custom positions and/or attention mask: replicated [B, ...]
         arrays split to [M, mb, ...] and indexed per microbatch)."""
@@ -320,10 +323,18 @@ def make_pipelined_apply(
             with pctx.use(pctx.ParallelContext(
                 mesh=mesh, enable_constraints=False, attn_impl="xla",
             )):
+                # Dropout forces the dense schedule: the cond branches
+                # then differ in AD residuals (the work branch carries
+                # PRNG-key/dropout-mask residuals the passthrough branch
+                # lacks), which trips an internal assertion in JAX's cond
+                # partial-eval (jax 0.9 conditionals.py:619).  Dense is
+                # trajectory-identical, just without the bubble skip.
+                eff_schedule = "dense" if use_dropout else schedule
                 out = spmd_pipeline(
-                    make_stage_fn(key_data, positions_mbs, mask_mbs),
+                    make_stage_fn(key_data, positions_mbs, mask_mbs,
+                                  use_dropout),
                     layer_params, mbs,
-                    n_stages=S, axis_name=axis_name, schedule=schedule,
+                    n_stages=S, axis_name=axis_name, schedule=eff_schedule,
                 )
             return out.reshape(x.shape)  # fp32 across the region boundary
 
@@ -348,10 +359,10 @@ def make_pipelined_apply(
         # boolean [B, 1|H, Q, K] (ops/attention convention); the causal
         # mask itself stays implicit in the attention op.
         dropout_key = (rngs or {}).get("dropout")
-        if cfg.dropout_rate and dropout_key is None:
-            raise ValueError(
-                "cfg.dropout_rate > 0 needs rngs={'dropout': key}"
-            )
+        # flax missing-rng convention: no dropout key -> deterministic
+        # pass (dropout off) — the eval path relies on this; training
+        # through AutoDistribute.step always passes the step rng.
+        use_dropout = cfg.dropout_rate > 0 and dropout_key is not None
         key_data = jax.random.key_data(
             dropout_key if dropout_key is not None else jax.random.key(0)
         )
@@ -367,7 +378,8 @@ def make_pipelined_apply(
         # AllReducePromotion pass (reducer contains a Sharding custom-call
         # it cannot clone), and fp32 residual transport across stage hops
         # is numerically conservative anyway.  Stage compute stays bf16.
-        pipe = make_pipe(positions is not None, mask is not None)
+        pipe = make_pipe(positions is not None, mask is not None,
+                         use_dropout)
         # plain model.apply accepts broadcastable extras (leading dim 1);
         # the microbatch split needs the full batch dim — broadcast first
         B = tokens.shape[0]

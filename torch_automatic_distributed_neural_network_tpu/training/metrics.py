@@ -106,20 +106,26 @@ class MetricsLogger:
                 record[k] = float(v)
             except (TypeError, ValueError):
                 pass
+        parts = [f"step {step:5d}"]
+        if "loss" in record:
+            parts.append(f"loss {record['loss']:.4f}")
+        ips = record.get(f"{self.items_name}_per_sec_per_chip")
+        if ips:
+            parts.append(f"{ips:,.0f} {self.items_name}/s/chip")
+        if "mfu" in record:
+            parts.append(f"MFU {record['mfu']:.1%}")
+        self._emit(record, parts,
+                   console=self.console and step % self.console_every == 0)
+        return record
+
+    def _emit(self, record: dict, console_parts: list[str],
+              *, console: bool) -> None:
+        """Shared sink: JSONL write + optional host-0 console line."""
         if self._file:
             self._file.write(json.dumps(record) + "\n")
             self._file.flush()
-        if self.console and step % self.console_every == 0:
-            parts = [f"step {step:5d}"]
-            if "loss" in record:
-                parts.append(f"loss {record['loss']:.4f}")
-            ips = record.get(f"{self.items_name}_per_sec_per_chip")
-            if ips:
-                parts.append(f"{ips:,.0f} {self.items_name}/s/chip")
-            if "mfu" in record:
-                parts.append(f"MFU {record['mfu']:.1%}")
-            print("  ".join(parts), file=sys.stderr)
-        return record
+        if console:
+            print("  ".join(console_parts), file=sys.stderr)
 
     def log_eval(self, step: int, metrics: dict) -> dict:
         """Write an evaluation record: plain fields only — no step-time /
@@ -131,15 +137,11 @@ class MetricsLogger:
                 record[k] = float(v)
             except (TypeError, ValueError):
                 pass
-        if self._file:
-            self._file.write(json.dumps(record) + "\n")
-            self._file.flush()
-        if self.console:
-            parts = [f"step {step:5d}"] + [
-                f"{k} {v:.4f}" for k, v in record.items()
-                if k not in ("step", "time")
-            ]
-            print("  ".join(parts), file=sys.stderr)
+        parts = [f"step {step:5d}"] + [
+            f"{k} {v:.4f}" for k, v in record.items()
+            if k not in ("step", "time")
+        ]
+        self._emit(record, parts, console=self.console)
         return record
 
     def close(self) -> None:

@@ -47,6 +47,15 @@ class TrainerConfig:
     eval_batches: int = 8  # batches per periodic evaluation
 
 
+def _is_step_indexed(data: Any) -> bool:
+    """Step-indexed source: declares ``step_indexed = True`` and has a
+    ``.batch(i)`` method (an explicit marker — ``.batch(n)`` on common
+    iterables like tf.data means a batch-size transform)."""
+    return bool(getattr(data, "step_indexed", False)) and callable(
+        getattr(data, "batch", None)
+    )
+
+
 class Trainer:
     def __init__(
         self,
@@ -75,9 +84,7 @@ class Trainer:
         """Mean forward-only metrics over ``n_batches`` of ``data``
         (step-indexed source or iterable) using ``ad.eval_step`` —
         deterministic (no dropout), no optimizer/state mutation."""
-        indexed = getattr(data, "step_indexed", False) and callable(
-            getattr(data, "batch", None)
-        )
+        indexed = _is_step_indexed(data)
         it = None if indexed else iter(data)
         totals: dict[str, float] = {}
         n = 0
@@ -123,9 +130,7 @@ class Trainer:
         from its beginning on resume.
         """
         cfg = self.cfg
-        indexed = getattr(data, "step_indexed", False) and callable(
-            getattr(data, "batch", None)
-        )
+        indexed = _is_step_indexed(data)
         data_iter = None if indexed else iter(data)
         first = None
         if state is None:
@@ -196,6 +201,9 @@ class Trainer:
                     )
                     if self.metrics:
                         self.metrics.log_eval(i + 1, ev)
+                        # eval wall time must not bleed into the next
+                        # training record's step_time/MFU
+                        self.metrics.start_step()
                     elif jax.process_index() == 0:
                         print(f"step {i + 1} " + "  ".join(
                             f"{k} {v:.4f}" for k, v in ev.items()))
