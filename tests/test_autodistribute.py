@@ -193,6 +193,35 @@ def test_grad_accum_count_metrics_sum_not_average(devices8):
     assert tokens_metric(2) == tokens_metric(1) == 16 * 8
 
 
+def test_grad_accum_nested_aux(devices8):
+    """Nested aux pytrees survive accumulation (path-based reduction);
+    count leaves sum, ratio leaves average."""
+    import optax as _optax
+
+    from torch_automatic_distributed_neural_network_tpu.models import MLP
+    from torch_automatic_distributed_neural_network_tpu.training import (
+        softmax_xent_loss,
+    )
+
+    def nested_loss(params, batch, rng, apply_fn):
+        loss, aux = softmax_xent_loss(params, batch, rng, apply_fn)
+        return loss, {"outer": {"accuracy": aux["accuracy"],
+                                "items": jnp.asarray(
+                                    batch["label"].shape[0], jnp.float32)}}
+
+    ad = tad.AutoDistribute(
+        MLP(features=(16, 10)),
+        optimizer=_optax.sgd(0.1),
+        loss_fn=nested_loss,
+        strategy="dp",
+        grad_accum=2,
+    )
+    state = ad.init(jax.random.key(0), toy_batch())
+    _, m = ad.step(state, toy_batch())
+    assert float(m["outer"]["items"]) == 16  # summed: 2 slices of 8
+    assert 0.0 <= float(m["outer"]["accuracy"]) <= 1.0
+
+
 def test_grad_accum_divisibility_error(devices8):
     ad = make_ad("dp", grad_accum=3)
     with pytest.raises(ValueError, match="grad_accum"):

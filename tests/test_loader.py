@@ -146,3 +146,65 @@ def test_token_ids_over_int31_rejected(tmp_path):
     batch = ds.batch(0)
     assert batch["input_ids"].min() >= 0
     ds.close()
+
+
+class TestTextBridge:
+    """data/text.py: text -> TADN token file -> TokenFileDataset (C13)."""
+
+    def test_byte_tokenizer_roundtrip(self):
+        from torch_automatic_distributed_neural_network_tpu.data.text import (
+            ByteTokenizer,
+        )
+
+        tok = ByteTokenizer()
+        s = "héllo wörld\n"
+        ids = tok.encode(s)
+        assert all(0 <= i < 256 for i in ids)
+        assert tok.decode(ids) == s
+        assert tok.vocab_size == 258
+
+    def test_tokenize_file_feeds_dataset(self, tmp_path):
+        from torch_automatic_distributed_neural_network_tpu.data import (
+            TokenFileDataset,
+            tokenize_file,
+        )
+
+        text = tmp_path / "corpus.txt"
+        text.write_text("the quick brown fox\n" * 200, encoding="utf-8")
+        out = tmp_path / "corpus.tadn"
+        n = tokenize_file(str(text), str(out), log=False)
+        assert n == 200 * 20 + 1  # bytes + EOS
+        ds = TokenFileDataset(str(out), seq_len=16, batch_size=4)
+        b = ds.batch(0)
+        assert b["input_ids"].shape == (4, 17)
+        assert b["input_ids"].dtype == np.int32
+        # deterministic: same window -> same batch
+        np.testing.assert_array_equal(
+            ds.batch(3)["input_ids"], ds.batch(3)["input_ids"]
+        )
+
+    def test_tokenize_chunking_equals_whole_file(self, tmp_path):
+        """Chunked streaming (line-boundary cuts) must produce the same
+        ids as encoding the whole file at once."""
+        from torch_automatic_distributed_neural_network_tpu.data.text import (
+            ByteTokenizer,
+            tokenize_file,
+        )
+        from torch_automatic_distributed_neural_network_tpu.data.loader import (
+            TokenFileDataset,
+        )
+
+        content = "".join(f"line {i} with some text ä\n" for i in range(500))
+        text = tmp_path / "c.txt"
+        text.write_text(content, encoding="utf-8")
+        out_small = tmp_path / "small.tadn"
+        out_big = tmp_path / "big.tadn"
+        tokenize_file(str(text), str(out_small), chunk_chars=100, log=False)
+        tokenize_file(str(text), str(out_big), chunk_chars=1 << 24, log=False)
+        a = TokenFileDataset(str(out_small), seq_len=64, batch_size=2)
+        b = TokenFileDataset(str(out_big), seq_len=64, batch_size=2)
+        assert a.n_tokens == b.n_tokens == len(
+            content.encode("utf-8")) + 1
+        np.testing.assert_array_equal(
+            a.batch(0)["input_ids"], b.batch(0)["input_ids"]
+        )

@@ -26,6 +26,7 @@ from .topology import (
     Topology,
     build_mesh,
     detect,
+    enable_compilation_cache,
     initialize_distributed,
     mesh_degrees,
     single_device_mesh,
@@ -46,6 +47,7 @@ __all__ = [
     "Topology",
     "build_mesh",
     "detect",
+    "enable_compilation_cache",
     "initialize_distributed",
     "mesh_degrees",
     "single_device_mesh",

@@ -73,6 +73,10 @@ def _run_script(script: str, script_args: list[str]) -> int:
 
 
 def cmd_run(args: argparse.Namespace) -> int:
+    if not os.environ.get("TADNN_NO_COMPILE_CACHE"):
+        from .topology import enable_compilation_cache
+
+        enable_compilation_cache()
     _maybe_init_distributed()
     return _run_script(args.script, args.script_args)
 
@@ -97,6 +101,19 @@ def cmd_bench(args: argparse.Namespace) -> int:
     sizes = [int(s) for s in args.sizes.split(",")]
     for r in bench_sweep(sizes=sizes, ops=ops, axis=args.axis):
         print(json.dumps(r.to_json()))
+    return 0
+
+
+def cmd_tokenize(args: argparse.Namespace) -> int:
+    """Text -> TADN token file (data/text.py)."""
+    from .data.text import load_tokenizer, tokenize_file
+
+    tokenize_file(
+        args.input,
+        args.output,
+        tokenizer=load_tokenizer(args.tokenizer),
+        append_eos=not args.no_eos,
+    )
     return 0
 
 
@@ -128,6 +145,19 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--sizes", default=str(64 * 2**20))
     p.add_argument("--axis", default="data")
     p.set_defaults(fn=cmd_bench)
+
+    p = sub.add_parser(
+        "tokenize",
+        help="tokenize a UTF-8 text file into a native TADN token file "
+             "(data/loader.py) for the LM examples",
+    )
+    p.add_argument("input")
+    p.add_argument("output")
+    p.add_argument("--tokenizer", default="byte",
+                   help="'byte' (offline, vocab 258) or a transformers "
+                        "tokenizer name/path (tried local-first)")
+    p.add_argument("--no-eos", action="store_true")
+    p.set_defaults(fn=cmd_tokenize)
 
     args = parser.parse_args(argv)
     return args.fn(args)

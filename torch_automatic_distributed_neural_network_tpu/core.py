@@ -478,15 +478,16 @@ class AutoDistribute:
                 loss = loss / k
                 # Ratio metrics (accuracy, aux_loss) average over slices;
                 # COUNT metrics keep full-batch semantics by summing.
-                # Convention: keys named 'tokens'/'items' or ending in
-                # '_count' are counts (training/losses.py follows it).
-                aux = {
-                    key: (jnp.sum(v, axis=0)
-                          if key in ("tokens", "items")
-                          or key.endswith("_count")
-                          else jnp.mean(v, axis=0))
-                    for key, v in aux_stack.items()
-                }
+                # Convention: leaves keyed 'tokens'/'items' or '*_count'
+                # are counts (training/losses.py follows it).  Path-based
+                # tree_map so nested aux pytrees keep working.
+                def _reduce_aux(path, v):
+                    key = str(getattr(path[-1], "key", "")) if path else ""
+                    if key in ("tokens", "items") or key.endswith("_count"):
+                        return jnp.sum(v, axis=0)
+                    return jnp.mean(v, axis=0)
+
+                aux = jax.tree_util.tree_map_with_path(_reduce_aux, aux_stack)
                 if self._has_model_state:
                     aux["model_state"] = ms_final
             updates, opt_state = self.optimizer.update(

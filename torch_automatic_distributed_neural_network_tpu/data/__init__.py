@@ -11,6 +11,7 @@ from .arrays import (
     load_seq2seq,
 )
 from .loader import TokenFileDataset, shard_for_host, write_token_file
+from .text import ByteTokenizer, load_tokenizer, tokenize_file
 from .synthetic import SyntheticClassification, SyntheticLM
 
 __all__ = [
@@ -25,4 +26,7 @@ __all__ = [
     "TokenFileDataset",
     "shard_for_host",
     "write_token_file",
+    "ByteTokenizer",
+    "load_tokenizer",
+    "tokenize_file",
 ]

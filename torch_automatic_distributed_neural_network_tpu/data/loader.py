@@ -50,27 +50,72 @@ def _splitmix64(x: int) -> int:
     return x ^ (x >> 31)
 
 
+class TokenFileWriter:
+    """Streaming TADN v1 writer: append token chunks in bounded memory.
+
+    Writes the header with a zero count up front, streams every
+    ``append`` straight to disk, and patches ``n_tokens`` on close — so
+    tokenizing a corpus much larger than RAM never concatenates it
+    in-memory (data/text.py rides this).
+    """
+
+    def __init__(self, path: str, dtype=np.uint32):
+        dtype = np.dtype(dtype)
+        if dtype not in (np.dtype(np.uint16), np.dtype(np.uint32)):
+            raise ValueError(f"TADN dtype must be uint16/uint32, got {dtype}")
+        self._dtype = dtype
+        self.n_tokens = 0
+        self._f = open(path, "wb")
+        self._write_header()
+
+    def _write_header(self) -> None:
+        header = np.zeros((), _HEADER)
+        header["magic"] = _MAGIC
+        header["version"] = 1
+        header["dtype_bytes"] = self._dtype.itemsize
+        header["n_tokens"] = self.n_tokens
+        self._f.write(header.tobytes())
+
+    def append(self, tokens) -> None:
+        tokens = np.asarray(tokens).ravel()
+        if tokens.size == 0:
+            return
+        lo, hi = int(tokens.min()), int(tokens.max())
+        if lo < 0:
+            raise ValueError("tokens must be non-negative")
+        # batch() hands out int32 buffers (TPU-native token dtype); an
+        # id >= 2^31 would silently wrap negative on read.
+        limit = min(2**31, 2 ** (8 * self._dtype.itemsize))
+        if hi >= limit:
+            limit_str = "2**31" if limit == 2**31 else str(limit)
+            raise ValueError(
+                f"token id {hi} >= {limit_str} does not fit the file "
+                f"dtype {self._dtype.name} / the loader's int32 batches"
+            )
+        self._f.write(tokens.astype(self._dtype).tobytes())
+        self.n_tokens += int(tokens.size)
+
+    def close(self) -> None:
+        if self._f.closed:
+            return
+        self._f.seek(0)
+        self._write_header()  # patch the real count
+        self._f.close()
+
+    def __enter__(self) -> "TokenFileWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
 def write_token_file(path: str, tokens: np.ndarray) -> None:
     """Write a TADN v1 token file; dtype picked from the token range."""
     tokens = np.asarray(tokens).ravel()
-    if tokens.size and tokens.min() < 0:
-        raise ValueError("tokens must be non-negative")
-    if tokens.size and int(tokens.max()) >= 2**31:
-        # batch() hands out int32 buffers (TPU-native token dtype); a
-        # uint32 id >= 2^31 would silently wrap negative on read.
-        raise ValueError(
-            f"token id {int(tokens.max())} >= 2**31 cannot round-trip "
-            "through the loader's int32 batches"
-        )
-    dtype = np.uint16 if (tokens.size == 0 or tokens.max() < 2**16) else np.uint32
-    header = np.zeros((), _HEADER)
-    header["magic"] = _MAGIC
-    header["version"] = 1
-    header["dtype_bytes"] = dtype().itemsize
-    header["n_tokens"] = tokens.size
-    with open(path, "wb") as f:
-        f.write(header.tobytes())
-        f.write(tokens.astype(dtype).tobytes())
+    dtype = np.uint16 if (
+        tokens.size == 0 or int(tokens.max()) < 2**16) else np.uint32
+    with TokenFileWriter(path, dtype=dtype) as w:
+        w.append(tokens)
 
 
 _build_lock = threading.Lock()

@@ -263,6 +263,24 @@ def mesh_degrees(mesh: Mesh) -> dict[str, int]:
     return {ax: int(n) for ax, n in zip(mesh.axis_names, mesh.devices.shape)}
 
 
+def enable_compilation_cache(cache_dir: str | None = None) -> str:
+    """Turn on JAX's persistent compilation cache.
+
+    Big-model XLA:TPU compiles run 20-40s+ (minutes at 1B+ scale); the
+    cache amortizes them across process restarts — which the elastic
+    story (training/elastic.py restart-based recovery) hits every
+    resume.  Safe to call multiple times; returns the cache dir.
+    ``tadnn run`` enables it by default (TADNN_NO_COMPILE_CACHE=1 opts
+    out).
+    """
+    cache_dir = cache_dir or os.path.expanduser("~/.cache/tadnn_xla")
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # cache everything that took meaningful compile time
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return cache_dir
+
+
 def initialize_distributed(**kwargs) -> None:
     """Multi-host runtime init — the ``torchrun``/``mp.spawn`` analog (C9).
 

@@ -18,6 +18,8 @@ from .metrics import MetricsLogger, peak_flops_per_chip, transformer_step_flops
 from .precision import Precision, resolve as resolve_precision
 
 _LAZY = {
+    "adamw_cosine": "optim",
+    "warmup_cosine": "optim",
     "CheckpointManager": "checkpoint",
     "abstract_state_for": "checkpoint",
     "restore_or_init": "checkpoint",
@@ -38,6 +40,8 @@ __all__ = [
     "seq2seq_loss",
     "mse_loss",
     "MetricsLogger",
+    "adamw_cosine",
+    "warmup_cosine",
     "peak_flops_per_chip",
     "transformer_step_flops",
     "Precision",

@@ -66,3 +66,57 @@ def opt_state_spec_tree(
         return by_shape.get(shape, P())
 
     return jax.tree_util.tree_map_with_path(assign, abstract_opt_state)
+
+
+# ---------------------------------------------------------------------------
+# LR schedules + optimizer presets (the torch.optim.lr_scheduler analog)
+# ---------------------------------------------------------------------------
+
+
+def warmup_cosine(
+    peak_lr: float,
+    total_steps: int,
+    *,
+    warmup_steps: int | None = None,
+    end_lr_frac: float = 0.1,
+):
+    """Linear warmup -> cosine decay, the standard LM pretraining schedule.
+
+    ``warmup_steps`` defaults to 1% of ``total_steps`` (min 100, capped at
+    total_steps // 10); decay ends at ``end_lr_frac * peak_lr``."""
+    import optax
+
+    if warmup_steps is None:
+        warmup_steps = min(max(100, total_steps // 100),
+                           max(1, total_steps // 10))
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=peak_lr,
+        warmup_steps=warmup_steps,
+        decay_steps=total_steps,
+        end_value=end_lr_frac * peak_lr,
+    )
+
+
+def adamw_cosine(
+    peak_lr: float = 3e-4,
+    total_steps: int = 10000,
+    *,
+    warmup_steps: int | None = None,
+    weight_decay: float = 0.1,
+    b1: float = 0.9,
+    b2: float = 0.95,
+    grad_clip: float = 1.0,
+):
+    """AdamW + global-norm clip + warmup-cosine — the standard GPT
+    pretraining recipe as a one-liner for ``AutoDistribute(optimizer=...)``.
+    """
+    import optax
+
+    tx = optax.adamw(
+        warmup_cosine(peak_lr, total_steps, warmup_steps=warmup_steps),
+        b1=b1, b2=b2, weight_decay=weight_decay,
+    )
+    if grad_clip:
+        tx = optax.chain(optax.clip_by_global_norm(grad_clip), tx)
+    return tx

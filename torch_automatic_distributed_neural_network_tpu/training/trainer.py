@@ -192,6 +192,7 @@ class Trainer:
                         )
                 if cfg.divergence_every and i % cfg.divergence_every == 0:
                     self._guard_divergence(state, i)
+                slow_block = False
                 if (
                     cfg.eval_every and self.eval_data is not None
                     and (i + 1) % cfg.eval_every == 0
@@ -199,11 +200,9 @@ class Trainer:
                     ev = self.evaluate(
                         self.eval_data, cfg.eval_batches, state=state
                     )
+                    slow_block = True
                     if self.metrics:
                         self.metrics.log_eval(i + 1, ev)
-                        # eval wall time must not bleed into the next
-                        # training record's step_time/MFU
-                        self.metrics.start_step()
                     elif jax.process_index() == 0:
                         print(f"step {i + 1} " + "  ".join(
                             f"{k} {v:.4f}" for k, v in ev.items()))
@@ -212,8 +211,13 @@ class Trainer:
                     and (i + 1) % cfg.ckpt_every == 0
                 ):
                     self.ckpt.save(i + 1, state, config=self.run_config)
+                    slow_block = True
                 for cb in self.callbacks:
                     cb(i + 1, state, step_metrics)
+                if slow_block and self.metrics:
+                    # eval/checkpoint wall time must not bleed into the
+                    # next training record's step_time/MFU
+                    self.metrics.start_step()
             if cfg.watchdog_timeout_s and pending_metrics is not None:
                 # flush the lag-one beat: the final step (the only step,
                 # when resuming one short of cfg.steps) must arm/beat the
