@@ -1,0 +1,30 @@
+"""utils/simenv.py: the one place CPU-sim child env surgery lives."""
+
+from torch_automatic_distributed_neural_network_tpu.utils.simenv import (
+    cpu_sim_env,
+)
+
+
+def test_cpu_sim_env_overrides():
+    base = {
+        "PYTHONPATH": "/root/.axon_site:/some/real/path",
+        "JAX_PLATFORMS": "axon",
+        "XLA_FLAGS": "--xla_foo=1 --xla_force_host_platform_device_count=2",
+        "HOME": "/root",
+    }
+    env = cpu_sim_env(8, base)
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert "axon" not in env["PYTHONPATH"]
+    assert "/some/real/path" in env["PYTHONPATH"]
+    assert "--xla_force_host_platform_device_count=8" in env["XLA_FLAGS"]
+    assert env["XLA_FLAGS"].count("device_count") == 1
+    assert "--xla_foo=1" in env["XLA_FLAGS"]  # unrelated flags kept
+    assert env["HOME"] == "/root"
+
+
+def test_cpu_sim_env_extra_pythonpath_and_empty():
+    env = cpu_sim_env(4, {"PYTHONPATH": "/root/.axon_site"},
+                      extra_pythonpath=("/repo",))
+    assert env["PYTHONPATH"] == "/repo"
+    env2 = cpu_sim_env(4, {"PYTHONPATH": "/root/.axon_site"})
+    assert "PYTHONPATH" not in env2  # nothing survives -> var dropped

@@ -33,20 +33,65 @@ def annotate(name: str):
     return jax.profiler.TraceAnnotation(name)
 
 
-def compiled_flops(fn, *args, **kwargs) -> float | None:
-    """FLOP estimate for a jitted callable from XLA's cost analysis.
-
-    Returns None when the backend doesn't expose cost analysis (e.g. some
-    experimental platforms); callers fall back to analytic 6ND estimates.
-    """
+def _flops_of(compiled) -> float | None:
     try:
-        compiled = fn.lower(*args, **kwargs).compile()
         cost = compiled.cost_analysis()
         if isinstance(cost, list):  # some backends return one dict per device
             cost = cost[0]
         return float(cost.get("flops", 0.0)) or None
     except Exception:
         return None
+
+
+def _memory_of(compiled) -> dict | None:
+    try:
+        ma = compiled.memory_analysis()
+        if ma is None:
+            return None
+        out = {}
+        for k in ("argument_size_in_bytes", "output_size_in_bytes",
+                  "temp_size_in_bytes", "alias_size_in_bytes",
+                  "generated_code_size_in_bytes"):
+            v = getattr(ma, k, None)
+            if v is not None:
+                out[k.replace("_in_bytes", "")] = int(v)
+        return out or None
+    except Exception:
+        return None
+
+
+def compiled_cost(fn, *args, **kwargs) -> dict | None:
+    """ONE AOT compile, both analyses: ``{'flops': ..., 'memory': ...}``.
+
+    Prefer this over calling :func:`compiled_flops` and
+    :func:`compiled_memory` separately — each does its own
+    lower().compile(), minutes of redundant XLA work on big sharded
+    steps.  None when the backend can't lower/compile at all.
+    """
+    try:
+        compiled = fn.lower(*args, **kwargs).compile()
+    except Exception:
+        return None
+    return {"flops": _flops_of(compiled), "memory": _memory_of(compiled)}
+
+
+def compiled_flops(fn, *args, **kwargs) -> float | None:
+    """FLOP estimate for a jitted callable from XLA's cost analysis.
+
+    Returns None when the backend doesn't expose cost analysis (e.g. some
+    experimental platforms); callers fall back to analytic 6ND estimates.
+    """
+    cost = compiled_cost(fn, *args, **kwargs)
+    return cost["flops"] if cost else None
+
+
+def compiled_memory(fn, *args, **kwargs) -> dict | None:
+    """Per-executable memory breakdown from XLA's memory analysis:
+    argument/output/temp/alias sizes in bytes.  The ground truth to check
+    the planner's analytic HBM model against on real hardware.  None when
+    the backend doesn't expose it."""
+    cost = compiled_cost(fn, *args, **kwargs)
+    return cost["memory"] if cost else None
 
 
 def memory_stats(device: Any | None = None) -> dict | None:
