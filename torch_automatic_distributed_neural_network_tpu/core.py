@@ -97,8 +97,13 @@ class AutoDistribute:
         planner's HBM model accounts for the chosen dtypes.
     pipeline_schedule:
         'cond' (default; bubble iterations skip their stage compute via a
-        per-device lax.cond) | 'dense' (compute-everything-and-mask).
-        Trajectory-identical; see parallel/pipeline.py.
+        per-device lax.cond) | 'dense' (compute-everything-and-mask) |
+        '1f1b' (hand-scheduled backward under custom_vjp: live stage
+        inputs bounded by 2S-1 instead of M+S-1 — the schedule for large
+        microbatch counts; costs one extra forward wavefront, ~25% more
+        step FLOPs than the remat-everything policy, in exchange for the
+        M-independent memory bound).
+        All trajectory-identical; see parallel/pipeline.py.
     grad_accum:
         Accumulate gradients over this many sequential slices of every
         batch before the (single) optimizer update — train with k x the
@@ -301,7 +306,14 @@ class AutoDistribute:
         if self.plan is None:
             self.build_plan(rng, sample_batch)
         self._check_batch(sample_batch)
+        make_state = self._make_state_fn(sample_batch)
+        abstract = jax.eval_shape(make_state, rng)
+        shardings = self.state_shardings(abstract)
+        state = jax.jit(make_state, out_shardings=shardings)(rng)
+        self._compile_step(abstract, shardings)
+        return state
 
+    def _make_state_fn(self, sample_batch):
         def make_state(rng):
             init_rng, state_rng = jax.random.split(rng)
             params, model_state = self._split_variables(
@@ -316,11 +328,62 @@ class AutoDistribute:
                 model_state=model_state,
             )
 
-        abstract = jax.eval_shape(make_state, rng)
+        return make_state
+
+    def compile_report(self, rng: jax.Array, sample_batch: Any) -> dict | None:
+        """AOT-compile the full sharded train step from ABSTRACT shapes only
+        — no parameters, optimizer state, or activations are ever
+        materialized — and return XLA's cost + memory analysis for it.
+
+        The "will it fit before I rent the slice" tool: run on a simulated
+        mesh of the target topology's size (SURVEY.md §4 CPU-sim row) and
+        read the per-device byte budget XLA reserves for the real step.
+        Returns ``{'flops': float|None, 'memory': {'argument_size': ...,
+        'temp_size': ..., 'output_size': ..., 'alias_size': ...},
+        'per_device_peak_bytes': int|None}`` — all sizes are PER DEVICE
+        (XLA analyses the per-device SPMD executable).  ``None`` when the
+        backend exposes no analysis.
+
+        Peak accounting: with buffer donation the state aliases the output,
+        so the live set is argument + temp (``alias_size`` counted once);
+        ``temp_size`` includes every activation/residual XLA keeps across
+        the step at its chosen schedule.
+        """
+        if self.plan is None:
+            self.build_plan(rng, sample_batch)
+        self._check_batch(sample_batch)
+        abstract = jax.eval_shape(self._make_state_fn(sample_batch), rng)
         shardings = self.state_shardings(abstract)
-        state = jax.jit(make_state, out_shardings=shardings)(rng)
-        self._compile_step(abstract, shardings)
-        return state
+        if self._step_fn is None:
+            self._compile_step(abstract, shardings)
+
+        def sds(a, s):
+            return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s)
+
+        state_abs = jax.tree.map(sds, abstract, shardings)
+        batch_abs = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
+            sample_batch,
+        )
+        from .utils.profiling import compiled_cost
+
+        cost = compiled_cost(self._step_fn, state_abs, batch_abs)
+        if cost is None:
+            return None
+        mem = cost.get("memory") or {}
+        peak = None
+        if mem:
+            # live set = args + temps + whatever of the output is NOT
+            # aliased into a donated argument (with donation alias_size
+            # covers the state and the correction term is ~0; with
+            # donate=False the output is a second full state buffer)
+            peak = (
+                int(mem.get("argument_size", 0))
+                + int(mem.get("temp_size", 0))
+                + max(0, int(mem.get("output_size", 0))
+                      - int(mem.get("alias_size", 0)))
+            )
+        return {**cost, "per_device_peak_bytes": peak}
 
     def _check_batch(self, batch) -> None:
         """Fail with a readable message when the global batch does not divide

@@ -179,6 +179,173 @@ def spmd_pipeline(
 
 
 # ---------------------------------------------------------------------------
+# 1F1B: memory-bounded backward schedule
+# ---------------------------------------------------------------------------
+
+
+def onef_oneb_grads(
+    stage_fn: Callable[[Any, jax.Array, jax.Array], jax.Array],
+    stage_params: Any,
+    microbatches: jax.Array,
+    cotangents: jax.Array,
+    *,
+    n_stages: int,
+    axis_name: str = "pipe",
+) -> tuple[Any, jax.Array]:
+    """Hand-scheduled 1F1B-style combined forward+backward pass.
+
+    Runs inside the same partial-manual ``shard_map`` region as
+    :func:`spmd_pipeline`; returns ``(param_grads, input_cotangents)``
+    for the whole trunk given output ``cotangents`` of shape
+    ``[M, mb, ...]``.
+
+    Why a hand-written backward at all: reverse-mode AD through the GPipe
+    scan stashes one stage-input per iteration — ``M + S - 1`` live
+    activations — and (jax 0.9) refuses `lax.cond` in the differentiated
+    path when branches carry different residuals (dropout).  This
+    schedule is not differentiated — each backward tick recomputes its
+    stage forward from a stashed input and applies the cotangent with an
+    explicit ``jax.vjp`` — so both limits disappear:
+
+    - live stage inputs are a ring buffer of ``2S - 1`` slots (the
+      lockstep in-flight bound), independent of M;
+    - bubbles skip compute via ``lax.cond`` even with dropout on.
+
+    Lockstep schedule over ``T = M + 2S - 1`` ticks: stage ``s`` runs
+    fwd(m) at tick ``t = m + s`` (the GPipe wavefront) and bwd(m) at
+    ``t = m + 2S - 1 - s`` — one tick after the cotangent for ``m``
+    leaves stage ``s+1``, riding a reverse ``ppermute`` ring.  A stash
+    entry lives ``2(S - s) - 1 <= 2S - 1`` ticks, so indexing the ring
+    by ``m mod (2S-1)`` never collides — PROVIDED each tick reads its
+    backward stash entry before the forward slot writes (at stage 0 the
+    two land on the same slot in the same tick; see the ordering note in
+    ``tick``).
+
+    FLOP accounting, in forward-units (bwd ~= 2 fwd): this pass runs the
+    forward wavefront (to regenerate inter-stage activations and
+    stashes) + per-tick vjp recompute + backward = 4 units, on top of
+    the primal forward the custom_vjp wrapper already ran = **5 units
+    total, vs 4 for AD-GPipe with the remat-everything policy** — one
+    extra forward (~25% more step FLOPs) is the price of the
+    M-independent memory bound.  Worth it exactly when M must be large
+    (deep pipelines want M >> S to kill the bubble fraction) and
+    activations, not FLOPs, are the binding constraint.
+    """
+    S = n_stages
+    M = microbatches.shape[0]
+    B = 2 * S - 1  # stash ring size: max in-flight per stage
+    stage = jax.lax.axis_index(axis_name)
+
+    microbatches = _to_varying(microbatches, axis_name)
+    cotangents = _to_varying(cotangents, axis_name)
+
+    act0 = jnp.zeros_like(microbatches[0])
+    cot0 = jnp.zeros_like(cotangents[0])
+    # carries must be device-varying along the pipe axis like the data
+    # they are updated with (scan carry types are checked structurally)
+    stash0 = _to_varying(
+        jnp.zeros((B,) + act0.shape, act0.dtype), axis_name
+    )
+    # fp32 grad accumulators (cast to the param dtype on exit);
+    # stage_params is varying along pipe, so the accumulators must be too
+    dparams0 = jax.tree.map(
+        lambda p: _to_varying(jnp.zeros(p.shape, jnp.float32), axis_name),
+        stage_params,
+    )
+    dmbs0 = jnp.zeros_like(microbatches)
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+    bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+
+    def tick(carry, t):
+        act, cot, stash, dparams, dmbs = carry
+
+        # ---- backward stash read FIRST ----
+        # At stage 0 the forward wavefront writes microbatch t into slot
+        # t % B in the same tick the backward reads microbatch t - B from
+        # the SAME slot (their index difference is exactly B = 2S-1).
+        # Reading before writing keeps the ring size at the 2S-1 lifetime
+        # bound; read-after-write here silently corrupts stage-0
+        # gradients whenever M > S.
+        mb_i = t - (2 * S - 1) + stage
+        work_b = jnp.logical_and(mb_i >= 0, mb_i < M)
+        mb_c = jnp.clip(mb_i, 0, M - 1)
+        x0 = jax.lax.dynamic_index_in_dim(stash, mb_c % B, 0, keepdims=False)
+
+        # ---- forward slot (the GPipe wavefront) ----
+        mf = jnp.clip(t - stage, 0, M - 1)
+        inp = jnp.where(
+            stage == 0,
+            jax.lax.dynamic_index_in_dim(
+                microbatches, jnp.clip(t, 0, M - 1), 0, keepdims=False
+            ),
+            act,
+        )
+        work_f = jnp.logical_and(t - stage >= 0, t - stage < M)
+        y = jax.lax.cond(
+            work_f, lambda a: stage_fn(stage_params, a, mf), lambda a: a, inp
+        )
+        # stash the stage INPUT for the recompute at this microbatch's
+        # backward tick
+        slot_f = mf % B
+        old = jax.lax.dynamic_index_in_dim(stash, slot_f, 0, keepdims=False)
+        stash = jax.lax.dynamic_update_index_in_dim(
+            stash, jnp.where(work_f, inp, old), slot_f, 0
+        )
+
+        # ---- backward slot ----
+        g_in = jnp.where(
+            stage == S - 1,
+            jax.lax.dynamic_index_in_dim(cotangents, mb_c, 0, keepdims=False),
+            cot,
+        )
+
+        def do_bwd(operand):
+            x0, g = operand
+            _, vjp_fn = jax.vjp(
+                lambda p, xx: stage_fn(p, xx, mb_c), stage_params, x0
+            )
+            dp, dx = vjp_fn(g)
+            return jax.tree.map(
+                lambda a: a.astype(jnp.float32), dp
+            ), dx.astype(jnp.float32)
+
+        def no_bwd(operand):
+            _, g = operand
+            return jax.tree.map(
+                lambda p: _to_varying(
+                    jnp.zeros(p.shape, jnp.float32), axis_name
+                ),
+                stage_params,
+            ), g.astype(jnp.float32)
+
+        dp, dx = jax.lax.cond(work_b, do_bwd, no_bwd, (x0, g_in))
+        dparams = jax.tree.map(jnp.add, dparams, dp)
+        # stage 0's dx is the trunk-input cotangent for microbatch mb_i
+        store = jnp.logical_and(stage == 0, work_b)
+        cur = jax.lax.dynamic_index_in_dim(dmbs, mb_c, 0, keepdims=False)
+        dmbs = jax.lax.dynamic_update_index_in_dim(
+            dmbs, jnp.where(store, dx.astype(dmbs.dtype), cur), mb_c, 0
+        )
+
+        # activation hops forward, cotangent hops backward
+        act = jax.lax.ppermute(y, axis_name, fwd_perm)
+        cot = jax.lax.ppermute(dx, axis_name, bwd_perm)
+        return (act, cot, stash, dparams, dmbs), None
+
+    (_, _, _, dparams, dmbs), _ = jax.lax.scan(
+        tick, (act0, cot0, stash0, dparams0, dmbs0),
+        jnp.arange(M + 2 * S - 1),
+    )
+    dparams = jax.tree.map(
+        lambda g, p: g.astype(p.dtype), dparams, stage_params
+    )
+    # only stage 0 wrote real input cotangents; replicate along pipe (fp32
+    # through the region boundary, same rationale as spmd_pipeline)
+    masked = jnp.where(stage == 0, dmbs, jnp.zeros_like(dmbs))
+    return dparams, jax.lax.psum(masked, axis_name)
+
+
+# ---------------------------------------------------------------------------
 # DecoderLM integration
 # ---------------------------------------------------------------------------
 
@@ -210,6 +377,8 @@ def make_pipelined_apply(
     """
     from ..models.transformer_core import DecoderLayer, DecoderLM, make_norm
 
+    if schedule not in ("cond", "dense", "1f1b"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
     if not isinstance(model, DecoderLM):
         raise TypeError(
             f"pipeline parallelism needs a DecoderLM-family model "
@@ -299,8 +468,27 @@ def make_pipelined_apply(
     def _split_mb(t, b):
         return t.reshape((M, b // M) + t.shape[1:])
 
+    def _unpack_extras(extras, b, has_pos, has_mask):
+        """Shared by the forward and 1F1B-backward regions: split the
+        replicated custom positions / attention mask to [M, mb, ...]."""
+        it = iter(extras)
+        positions_mbs = _split_mb(next(it), b) if has_pos else None
+        mask_mbs = _split_mb(next(it), b) if has_mask else None
+        return positions_mbs, mask_mbs
+
+    def _region_ctx():
+        """Inside a pipeline region: manual over pipe, auto over
+        everything else.  Mesh-axis sharding constraints are disabled
+        (they would name auto axes from inside a manual region) and
+        attention is forced to the einsum path, which GSPMD partitions
+        over the auto axes."""
+        return pctx.use(pctx.ParallelContext(
+            mesh=mesh, enable_constraints=False, attn_impl="xla",
+        ))
+
     @functools.lru_cache(maxsize=None)
-    def make_pipe(has_pos: bool, has_mask: bool, use_dropout: bool = True):
+    def make_pipe(has_pos: bool, has_mask: bool, use_dropout: bool = True,
+                  schedule_override: str | None = None):
         """shard_map'd pipeline region for the given extra-input shape
         (custom positions and/or attention mask: replicated [B, ...]
         arrays split to [M, mb, ...] and indexed per microbatch)."""
@@ -311,25 +499,24 @@ def make_pipelined_apply(
                 raise ValueError(
                     f"batch {b} not divisible by {M} microbatches"
                 )
-            it = iter(extras)
-            positions_mbs = _split_mb(next(it), b) if has_pos else None
-            mask_mbs = _split_mb(next(it), b) if has_mask else None
+            positions_mbs, mask_mbs = _unpack_extras(
+                extras, b, has_pos, has_mask
+            )
             mbs = _split_mb(x, b)
-            # Inside the region: manual over pipe, auto over everything
-            # else.  Mesh-axis sharding constraints are disabled (they
-            # would name auto axes from inside a manual region) and
-            # attention is forced to the einsum path, which GSPMD
-            # partitions over the auto axes.
-            with pctx.use(pctx.ParallelContext(
-                mesh=mesh, enable_constraints=False, attn_impl="xla",
-            )):
-                # Dropout forces the dense schedule: the cond branches
-                # then differ in AD residuals (the work branch carries
-                # PRNG-key/dropout-mask residuals the passthrough branch
-                # lacks), which trips an internal assertion in JAX's cond
-                # partial-eval (jax 0.9 conditionals.py:619).  Dense is
-                # trajectory-identical, just without the bubble skip.
-                eff_schedule = "dense" if use_dropout else schedule
+            with _region_ctx():
+                # Dropout forces the dense schedule UNDER AD: the cond
+                # branches then differ in AD residuals (the work branch
+                # carries PRNG-key/dropout-mask residuals the passthrough
+                # branch lacks), which trips an internal assertion in
+                # JAX's cond partial-eval (jax 0.9 conditionals.py:619).
+                # Dense is trajectory-identical, just without the bubble
+                # skip.  The 1F1B path passes schedule_override='cond':
+                # its forward is inside custom_vjp and never
+                # differentiated, so cond is safe even with dropout.
+                if schedule_override is not None:
+                    eff_schedule = schedule_override
+                else:
+                    eff_schedule = "dense" if use_dropout else schedule
                 out = spmd_pipeline(
                     make_stage_fn(key_data, positions_mbs, mask_mbs,
                                   use_dropout),
@@ -346,6 +533,66 @@ def make_pipelined_apply(
             out_specs=P(),
             axis_names={axis_name},
         )
+
+    def _float0_zeros(x):
+        import numpy as _np
+
+        return _np.zeros(_np.shape(x), dtype=jax.dtypes.float0)
+
+    @functools.lru_cache(maxsize=None)
+    def make_trunk_1f1b(has_pos: bool, has_mask: bool,
+                        use_dropout: bool = True):
+        """The 1F1B trunk: forward = the cond-schedule pipeline (safe even
+        with dropout — custom_vjp means it is never differentiated),
+        backward = :func:`onef_oneb_grads`' hand-scheduled lockstep pass.
+        Memory: AD never stashes per-tick residuals here; the backward's
+        live set is the 2S-1 stash ring + the (params, x) custom_vjp
+        residual."""
+        fwd_pipe = make_pipe(has_pos, has_mask, use_dropout,
+                             schedule_override="cond")
+        n_extras = int(has_pos) + int(has_mask)
+
+        def bwd_region(layer_params, x, key_data, *extras_g):
+            *extras, g = extras_g
+            b = x.shape[0]
+            positions_mbs, mask_mbs = _unpack_extras(
+                extras, b, has_pos, has_mask
+            )
+            with _region_ctx():
+                dparams, dmbs = onef_oneb_grads(
+                    make_stage_fn(key_data, positions_mbs, mask_mbs,
+                                  use_dropout),
+                    layer_params, _split_mb(x, b), _split_mb(g, b),
+                    n_stages=S, axis_name=axis_name,
+                )
+            return dparams, dmbs.reshape(x.shape)
+
+        bwd_pipe = shard_map(
+            bwd_region,
+            mesh=mesh,
+            in_specs=(P(axis_name), P(), P()) + (P(),) * (n_extras + 1),
+            out_specs=(P(axis_name), P()),
+            axis_names={axis_name},
+        )
+
+        @jax.custom_vjp
+        def trunk(layer_params, x, key_data, *extras):
+            return fwd_pipe(layer_params, x, key_data, *extras)
+
+        def trunk_fwd(layer_params, x, key_data, *extras):
+            out = fwd_pipe(layer_params, x, key_data, *extras)
+            return out, (layer_params, x, key_data, extras)
+
+        def trunk_bwd(res, g):
+            layer_params, x, key_data, extras = res
+            dparams, dx = bwd_pipe(layer_params, x, key_data, *extras, g)
+            # integer-dtype primals (rng key data, positions, mask) take
+            # float0 cotangents
+            return (dparams, dx, _float0_zeros(key_data),
+                    *(map(_float0_zeros, extras)))
+
+        trunk.defvjp(trunk_fwd, trunk_bwd)
+        return trunk
 
     embed = nn.Embed(
         cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
@@ -378,8 +625,12 @@ def make_pipelined_apply(
         # AllReducePromotion pass (reducer contains a Sharding custom-call
         # it cannot clone), and fp32 residual transport across stage hops
         # is numerically conservative anyway.  Stage compute stays bf16.
-        pipe = make_pipe(positions is not None, mask is not None,
-                         use_dropout)
+        if schedule == "1f1b":
+            pipe = make_trunk_1f1b(positions is not None, mask is not None,
+                                   use_dropout)
+        else:
+            pipe = make_pipe(positions is not None, mask is not None,
+                             use_dropout)
         # plain model.apply accepts broadcastable extras (leading dim 1);
         # the microbatch split needs the full batch dim — broadcast first
         B = tokens.shape[0]
