@@ -60,6 +60,9 @@ class RunCfg:
 class ParallelCfg:
     strategy: str = "auto"
     seq: int = 1  # context-parallel degree (ring/Ulysses attention)
+    pipe: int = 1  # pipeline stages (1 = no pipeline)
+    microbatches: int = 8
+    schedule: str = "cond"  # cond | dense | 1f1b (parallel/pipeline.py)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -102,6 +105,9 @@ def main():
         loss_fn=next_token_loss,
         strategy=cfg.parallel.strategy,
         seq_parallel=cfg.parallel.seq,
+        pipeline_stages=cfg.parallel.pipe,
+        microbatches=cfg.parallel.microbatches,
+        pipeline_schedule=cfg.parallel.schedule,
     )
 
     tokens_per_step = cfg.run.batch_size * cfg.model.seq_len
