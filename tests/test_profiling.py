@@ -27,6 +27,88 @@ def test_compiled_memory_step(devices8):
     assert mem["temp_size"] > 0
 
 
+def test_search_strategy_small_model_picks_dp(devices8):
+    """strategy='search' on a model that trivially fits: the first ladder
+    candidate (dp) must be accepted, with the measurement recorded."""
+    import numpy as np
+    import optax
+
+    import torch_automatic_distributed_neural_network_tpu as tad
+    from torch_automatic_distributed_neural_network_tpu.models import GPT2
+    from torch_automatic_distributed_neural_network_tpu.training import (
+        next_token_loss,
+    )
+
+    ad = tad.AutoDistribute(
+        GPT2("test", vocab_size=512, max_seq_len=64),
+        optimizer=optax.adamw(1e-4),
+        loss_fn=next_token_loss,
+        strategy="search",
+    )
+    sample = {"tokens": np.zeros((8, 65), np.int32)}
+    plan = ad.build_plan(jax.random.key(0), sample)
+    assert plan.strategy == "dp"
+    assert ad.search_report[0]["fits"] is True
+    # and the searched plan trains
+    state = ad.init(jax.random.key(0), sample)
+    state, m = ad.step(state, sample)
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_search_strategy_single_device_noop(devices8):
+    """search on 1 device degrades to the no-op dp path and still leaves
+    an (empty) search_report, per the documented contract."""
+    import numpy as np
+    import optax
+
+    import torch_automatic_distributed_neural_network_tpu as tad
+    from torch_automatic_distributed_neural_network_tpu.models import GPT2
+    from torch_automatic_distributed_neural_network_tpu.training import (
+        next_token_loss,
+    )
+
+    ad = tad.AutoDistribute(
+        GPT2("test", vocab_size=512, max_seq_len=64),
+        optimizer=optax.adamw(1e-4),
+        loss_fn=next_token_loss,
+        strategy="search",
+        devices=jax.devices()[:1],
+    )
+    sample = {"tokens": np.zeros((8, 65), np.int32)}
+    plan = ad.build_plan(jax.random.key(0), sample)
+    assert plan.strategy == "dp"
+    assert ad.search_report == []
+
+
+def test_search_strategy_escalates_on_memory(devices8):
+    """strategy='search' must reject a candidate whose MEASURED peak
+    exceeds the budget and escalate: GPT-2 large (774M) in fp32 is
+    ~12.4 GiB of train state — over the 8 GiB cpu-sim budget for dp
+    (replicated), under it for fsdp (ZeRO-3 over 8).  Abstract AOT
+    compiles only; nothing is materialized."""
+    import numpy as np
+    import optax
+
+    import torch_automatic_distributed_neural_network_tpu as tad
+    from torch_automatic_distributed_neural_network_tpu.models import GPT2
+    from torch_automatic_distributed_neural_network_tpu.training import (
+        next_token_loss,
+    )
+
+    ad = tad.AutoDistribute(
+        GPT2("large", max_seq_len=64),
+        optimizer=optax.adamw(1e-4),
+        loss_fn=next_token_loss,
+        strategy="search",
+    )
+    sample = {"tokens": np.zeros((8, 65), np.int32)}
+    plan = ad.build_plan(jax.random.key(0), sample)
+    assert plan.strategy != "dp"
+    assert ad.search_report[0]["strategy"] == "dp"
+    assert ad.search_report[0]["fits"] is False
+    assert ad.search_report[-1]["fits"] is True
+
+
 def test_compile_report_abstract_only(devices8):
     """compile_report AOT-compiles the sharded step without materializing
     any state (the memfit path, bench.py mode=memfit / BASELINE.md row 4):

@@ -79,10 +79,15 @@ class AutoDistribute:
     init_fn:
         ``(rng, batch) -> params`` — overrides ``model.init``.
     strategy:
-        'auto' | 'dp' | 'fsdp' | 'tp' | 'tp_fsdp' | 'ep' | 'ep_fsdp' |
-        'ep_tp' (MoE: experts on the expert axis, each expert
+        'auto' | 'search' | 'dp' | 'fsdp' | 'tp' | 'tp_fsdp' | 'ep' |
+        'ep_fsdp' | 'ep_tp' (MoE: experts on the expert axis, each expert
         Megatron-split on tensor).  'auto' picks from model size vs HBM
-        (planner.choose_strategy).
+        (planner.choose_strategy, analytic).  'search' walks an
+        escalation ladder and accepts the first candidate whose
+        XLA-measured per-device peak (compile_report: AOT compile from
+        abstract shapes, nothing materialized) fits the chip's HBM —
+        the measured version of 'auto'; per-candidate numbers land in
+        ``self.search_report``.
     mesh:
         Explicit ``jax.sharding.Mesh``; built from strategy if omitted.
     remat:
@@ -185,6 +190,7 @@ class AutoDistribute:
         self._pipelined_apply = None
         self._pctx = None
         self.plan: planner_mod.ShardPlan | None = None
+        self.search_report: list = []  # strategy='search' measurements
         self._step_fn = None
         self._eval_fn = None
         self._state_shardings = None
@@ -217,6 +223,8 @@ class AutoDistribute:
 
     def build_plan(self, rng: jax.Array, sample_batch: Any) -> planner_mod.ShardPlan:
         """Trace the init to abstract shapes and run the partition planner."""
+        if self._strategy == "search":
+            return self._search_plan(rng, sample_batch)
         abstract_vars = jax.eval_shape(self._init_variables, rng, sample_batch)
         abstract, abstract_ms = self._split_variables(abstract_vars)
         self._has_model_state = bool(jax.tree.leaves(abstract_ms))
@@ -260,6 +268,155 @@ class AutoDistribute:
                 schedule=self._pipeline_schedule,
             )
             self.plan.remat = False
+        return self.plan
+
+    # Escalation ladders for strategy='search': cheapest collectives
+    # first, sharded + remat last.  (strategy, outer_remat) pairs.
+    _SEARCH_LADDER_DENSE = (
+        ("dp", None), ("fsdp", None), ("tp_fsdp", None), ("tp_fsdp", True),
+    )
+    _SEARCH_LADDER_MOE = (
+        ("ep", None), ("ep_fsdp", None), ("fsdp", None), ("fsdp", True),
+    )
+    _SEARCH_SAFETY = 0.92  # accept a plan at <= this fraction of HBM
+
+    def _search_plan(self, rng: jax.Array, sample_batch: Any):
+        """Measurement-validated strategy selection (``strategy='search'``).
+
+        The analytic auto policy (planner.choose_strategy) predicts
+        persistent-state bytes but can only guess activations; this path
+        walks an escalation ladder and accepts the first candidate whose
+        **XLA-measured** per-device peak (:meth:`compile_report` — an AOT
+        compile from abstract shapes, nothing materialized) fits within
+        ``_SEARCH_SAFETY`` of the chip's HBM.  Every candidate's
+        measurement lands in ``self.search_report`` for observability.
+        Falls back to the analytic ``'auto'`` policy when the backend
+        exposes no memory analysis.
+        """
+        import warnings
+
+        self.search_report = []
+        orig_remat = self._remat
+        # measure against the devices the candidates actually compile on:
+        # an explicit mesh= wins over the process-global device list
+        if self._mesh is not None:
+            devices = list(self._mesh.devices.flat)
+        elif self._devices is not None:
+            devices = self._devices
+        else:
+            devices = jax.devices()
+        if len(devices) == 1:
+            self._strategy = "dp"  # no-op path; nothing to search
+            return self.build_plan(rng, sample_batch)
+        # one extra abstract init trace (candidates re-trace inside their
+        # build_plan) — only to pick the ladder; cheap relative to the
+        # per-candidate AOT compiles
+        abstract_vars = jax.eval_shape(
+            self._init_variables, rng, sample_batch
+        )
+        abstract, _ = self._split_variables(abstract_vars)
+        ladder = (
+            self._SEARCH_LADDER_MOE
+            if planner_mod.detect_expert_count(abstract)
+            else self._SEARCH_LADDER_DENSE
+        )
+        budget = self._SEARCH_SAFETY * planner_mod._hbm_bytes(
+            devices[0].device_kind
+        )
+        if orig_remat is not None:
+            # an explicit user remat= overrides the ladder's escalation
+            # dimension: measure every rung with the user's setting
+            seen = set()
+            ladder = tuple(
+                (s, orig_remat) for s, _ in ladder
+                if not (s in seen or seen.add(s))
+            )
+        last_built = None  # last (strategy, remat) that produced a plan
+
+        def reset(strategy, remat):
+            self.plan = None
+            self._step_fn = None
+            self._eval_fn = None
+            self._strategy, self._remat = strategy, remat
+
+        try:
+            for strat, remat in ladder:
+                reset(strat, remat)
+                try:
+                    report = self.compile_report(rng, sample_batch)
+                except ValueError as e:
+                    # candidate inapplicable (axis degrees don't divide,
+                    # no TP-matching params, ...): record and escalate
+                    self.search_report.append(
+                        {"strategy": strat, "remat": remat,
+                         "peak_bytes": None, "budget_bytes": int(budget),
+                         "fits": False, "flops": None, "error": str(e)}
+                    )
+                    continue
+                if report is None:
+                    # compiled_cost swallows lowering/compile exceptions
+                    # into None: a PER-CANDIDATE failure (e.g. a sharding
+                    # error only visible at lowering) — record, escalate
+                    self.search_report.append(
+                        {"strategy": strat, "remat": remat,
+                         "peak_bytes": None, "budget_bytes": int(budget),
+                         "fits": False, "flops": None,
+                         "error": "lower/compile failed (see logs)"}
+                    )
+                    continue
+                if not report.get("per_device_peak_bytes"):
+                    # compiled fine but no memory analysis: a backend
+                    # property, not a candidate property — stop searching
+                    warnings.warn(
+                        "strategy='search': backend exposes no memory "
+                        "analysis; falling back to the analytic 'auto' "
+                        "policy",
+                        stacklevel=2,
+                    )
+                    reset("auto", orig_remat)
+                    return self.build_plan(rng, sample_batch)
+                peak = report["per_device_peak_bytes"]
+                entry = {
+                    "strategy": strat, "remat": remat, "peak_bytes": peak,
+                    "budget_bytes": int(budget), "fits": peak <= budget,
+                    "flops": report.get("flops"),
+                }
+                self.search_report.append(entry)
+                last_built = (strat, remat)
+                if entry["fits"]:
+                    return self.plan
+        except Exception:
+            # unexpected failure mid-search: leave the object
+            # re-searchable instead of stuck on a ladder rung
+            reset("search", orig_remat)
+            raise
+        if last_built is None:
+            self._strategy, self._remat = "search", orig_remat
+            errs = {e.get("error") for e in self.search_report}
+            if len(errs) == 1:
+                # every rung failed identically -> a strategy-independent
+                # config error (e.g. batch vs grad_accum); surface it
+                # verbatim rather than as a topology-sounding failure
+                raise ValueError(errs.pop())
+            raise ValueError(
+                f"strategy='search': no ladder candidate was applicable "
+                f"to this model/topology: {self.search_report}"
+            )
+        if (self._strategy, self._remat) != last_built:
+            # the last candidate errored; rebuild the last one that
+            # actually produced a plan
+            reset(*last_built)
+            self.build_plan(rng, sample_batch)
+        warnings.warn(
+            f"strategy='search': no candidate fit "
+            f"{budget / 2**30:.1f} GiB "
+            f"(measured peaks: "
+            f"{[(e['strategy'], e.get('peak_bytes')) for e in self.search_report]}); "
+            f"keeping the most aggressive candidate "
+            f"{self._strategy!r} remat={self._remat} — expect OOM at "
+            f"init unless the budget table underestimates this chip",
+            stacklevel=2,
+        )
         return self.plan
 
     @property
