@@ -23,6 +23,22 @@ def test_bench_allreduce(capsys):
     assert rec["bus_bw_gbps"] > 0
 
 
+def test_fit_reports_candidates(capsys):
+    """`tadnn fit` answers "will it fit" from abstract AOT compiles: a
+    tiny model accepts dp on the first rung and prints its measurement
+    plus the chosen mesh."""
+    assert cli.main(["fit", "--family", "gpt2", "--size", "test",
+                     "--seq", "32", "--batch", "8",
+                     "--precision", "fp32"]) == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    first = json.loads(lines[0])
+    assert first["strategy"] == "dp" and first["fits"] is True
+    assert first["peak_gib"] > 0
+    summary = json.loads(lines[-1])
+    assert summary["chosen_strategy"] == "dp"
+    assert summary["mesh"]["data"] == 8
+
+
 def test_run_executes_script(tmp_path, capsys):
     script = tmp_path / "hello.py"
     script.write_text(

@@ -104,6 +104,64 @@ def cmd_bench(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_fit(args: argparse.Namespace) -> int:
+    """Will this model fit? Abstract-shapes AOT compile + XLA memory
+    analysis (AutoDistribute.compile_report) — nothing materialized, so
+    it answers for models far larger than this host.  One JSON line per
+    measured candidate."""
+    import jax
+    import numpy as np
+    import optax
+
+    from . import AutoDistribute
+    from .models import GPT2, Llama, MoE
+    from .training import moe_next_token_loss, next_token_loss
+
+    family = {"gpt2": GPT2, "llama": Llama, "moe": MoE}[args.family]
+    model = family(args.size, max_seq_len=args.seq)
+    loss = moe_next_token_loss if args.family == "moe" else next_token_loss
+    ad = AutoDistribute(
+        model,
+        optimizer=optax.adamw(1e-4),
+        loss_fn=loss,
+        strategy=args.strategy,
+        precision=args.precision,
+    )
+    sample = {"tokens": np.zeros((args.batch, args.seq + 1), np.int32)}
+    if args.strategy == "search":
+        ad.build_plan(jax.random.key(0), sample)
+        entries = ad.search_report or [
+            {"strategy": ad.plan.strategy, "note": "1-device no-op"}
+        ]
+    else:
+        report = ad.compile_report(jax.random.key(0), sample)
+        if report is None:
+            print(json.dumps({"error": "backend exposes no analysis"}))
+            return 1
+        entries = [{
+            "strategy": ad.plan.strategy,
+            "peak_bytes": report["per_device_peak_bytes"],
+            "flops": report.get("flops"),
+            "memory": report.get("memory"),
+        }]
+    for e in entries:
+        pb = e.get("peak_bytes")
+        if pb:
+            e["peak_gib"] = round(pb / 2**30, 3)
+        print(json.dumps(e))
+    chosen = ad.plan.strategy if ad.plan is not None else None
+    print(json.dumps({"chosen_strategy": chosen,
+                      "mesh": _mesh_degrees_or_none(ad)}))
+    return 0
+
+
+def _mesh_degrees_or_none(ad):
+    from . import topology as topo_mod
+
+    return (dict(topo_mod.mesh_degrees(ad.plan.mesh))
+            if ad.plan is not None else None)
+
+
 def cmd_tokenize(args: argparse.Namespace) -> int:
     """Text -> TADN token file (data/text.py)."""
     from .data.text import load_tokenizer, tokenize_file
@@ -145,6 +203,23 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--sizes", default=str(64 * 2**20))
     p.add_argument("--axis", default="data")
     p.set_defaults(fn=cmd_bench)
+
+    p = sub.add_parser(
+        "fit",
+        help="will this model fit? abstract AOT compile + XLA memory "
+             "analysis per device; with --strategy search, walks the "
+             "escalation ladder and reports every candidate",
+    )
+    p.add_argument("--family", default="gpt2",
+                   choices=("gpt2", "llama", "moe"))
+    p.add_argument("--size", default="1p3b",
+                   help="model size preset (e.g. gpt2: small/1p3b; "
+                        "llama: 8b)")
+    p.add_argument("--seq", type=int, default=1024)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--strategy", default="search")
+    p.add_argument("--precision", default="mixed")
+    p.set_defaults(fn=cmd_fit)
 
     p = sub.add_parser(
         "tokenize",
