@@ -75,6 +75,52 @@ def _make_trainer(tmp_path, steps, callbacks=None, devices=None):
     )
 
 
+@pytest.mark.parametrize("kill_at", [3, 4])
+def test_preemption_drain_checkpoints_and_resumes(
+    devices8, tmp_path, kill_at
+):
+    """SIGTERM mid-run (a TPU maintenance event / spot reclaim): the
+    PreemptionGuard drains cooperatively — Trainer saves a checkpoint at
+    the interrupted step and returns; a fresh fit resumes from there and
+    matches the uninterrupted trajectory.  kill_at=4 lands on a
+    ckpt_every=2 boundary where the periodic save already wrote the step
+    (orbax refuses overwrites — the drain must not re-save)."""
+    import os
+    import signal
+
+    data = SyntheticLM(vocab_size=256, seq_len=33, batch_size=8)
+    steps = 8
+
+    # uninterrupted oracle
+    t0 = _make_trainer(tmp_path / "a", steps)
+    final_a = t0.fit(data)
+    t0.ckpt.close()
+
+    # SIGTERM delivered during the kill step's callbacks; the handler
+    # sets the flag and the loop drains at that step's post-callback
+    # check
+    def bomb(step, state, metrics):
+        if step == kill_at:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    trainer = _make_trainer(tmp_path / "b", steps, callbacks=[bomb])
+    drained = trainer.fit(data)
+    assert int(drained.step) == kill_at
+    assert trainer.ckpt.latest_step() == kill_at
+
+    # resume to completion with a fresh trainer (no bomb)
+    trainer2 = _make_trainer(tmp_path / "b", steps)
+    final_b = trainer2.fit(data)
+    trainer.ckpt.close()
+    trainer2.ckpt.close()
+    assert int(final_b.step) == steps
+    np.testing.assert_allclose(
+        np.asarray(jax.tree.leaves(final_a.params)[0]),
+        np.asarray(jax.tree.leaves(final_b.params)[0]),
+        rtol=1e-6, atol=1e-6,
+    )
+
+
 def test_kill_and_resume_matches_uninterrupted(devices8, tmp_path):
     data = SyntheticLM(vocab_size=256, seq_len=33, batch_size=8)
     steps = 8

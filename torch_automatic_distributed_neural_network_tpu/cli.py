@@ -118,7 +118,10 @@ def cmd_fit(args: argparse.Namespace) -> int:
     from .training import moe_next_token_loss, next_token_loss
 
     family = {"gpt2": GPT2, "llama": Llama, "moe": MoE}[args.family]
-    model = family(args.size, max_seq_len=args.seq)
+    size = args.size or {"gpt2": "1p3b", "llama": "8b", "moe": "test"}[
+        args.family
+    ]
+    model = family(size, max_seq_len=args.seq)
     loss = moe_next_token_loss if args.family == "moe" else next_token_loss
     ad = AutoDistribute(
         model,
@@ -135,12 +138,21 @@ def cmd_fit(args: argparse.Namespace) -> int:
         ]
     else:
         report = ad.compile_report(jax.random.key(0), sample)
-        if report is None:
+        peak = report and report.get("per_device_peak_bytes")
+        if not peak:
             print(json.dumps({"error": "backend exposes no analysis"}))
             return 1
+        # same budget the search ladder measures against
+        from . import planner as planner_mod
+
+        budget = AutoDistribute._SEARCH_SAFETY * planner_mod._hbm_bytes(
+            jax.devices()[0].device_kind
+        )
         entries = [{
             "strategy": ad.plan.strategy,
-            "peak_bytes": report["per_device_peak_bytes"],
+            "peak_bytes": peak,
+            "budget_bytes": int(budget),
+            "fits": peak <= budget,
             "flops": report.get("flops"),
             "memory": report.get("memory"),
         }]
@@ -212,9 +224,9 @@ def main(argv: list[str] | None = None) -> int:
     )
     p.add_argument("--family", default="gpt2",
                    choices=("gpt2", "llama", "moe"))
-    p.add_argument("--size", default="1p3b",
-                   help="model size preset (e.g. gpt2: small/1p3b; "
-                        "llama: 8b)")
+    p.add_argument("--size", default=None,
+                   help="model size preset; default per family "
+                        "(gpt2: 1p3b, llama: 8b, moe: test)")
     p.add_argument("--seq", type=int, default=1024)
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--strategy", default="search")

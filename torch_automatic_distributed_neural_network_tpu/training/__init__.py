@@ -28,6 +28,7 @@ _LAZY = {
     "FaultInjector": "elastic",
     "Heartbeat": "elastic",
     "InjectedFault": "elastic",
+    "PreemptionGuard": "elastic",
     "StepWatchdog": "elastic",
     "run_with_recovery": "elastic",
 }

@@ -19,6 +19,11 @@ model the analogous subsystem is:
 - **FaultInjector**: deterministic fault injection for kill-and-resume
   tests (SURVEY.md §4: fault injection = kill-and-resume harness on CPU
   sim).
+- **PreemptionGuard**: cooperative SIGTERM drain.  TPU maintenance
+  events and spot reclamation deliver SIGTERM with a grace window; the
+  guard converts it into a flag the train loop polls each step, so the
+  Trainer saves a final checkpoint and returns cleanly instead of dying
+  mid-step and losing everything since the last periodic save.
 """
 
 from __future__ import annotations
@@ -206,6 +211,73 @@ class StepWatchdog:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+
+class PreemptionGuard:
+    """Cooperative SIGTERM/SIGUSR1 drain flag (see module docstring).
+
+    Signal handlers only install on the main thread (a Python
+    constraint); elsewhere ``install`` is a no-op and ``requested``
+    stays False — background-thread training loops keep working, just
+    without the drain.  ``request()`` lets tests (or a cluster agent
+    with its own notification channel) trip the flag directly.
+
+    Multi-host note: each host sees only its own signal.  The drain is
+    cooperative and assumes the orchestrator signals every host of the
+    slice (which is how TPU maintenance events behave); the final
+    checkpoint save is the usual collective path.
+    """
+
+    def __init__(self, signals: tuple[int, ...] | None = None):
+        import signal as _signal
+
+        self._signal = _signal
+        self._signals = (
+            signals if signals is not None
+            else (_signal.SIGTERM, _signal.SIGUSR1)
+        )
+        self._requested = threading.Event()
+        self._prev: dict[int, Any] = {}
+
+    def install(self) -> "PreemptionGuard":
+        if threading.current_thread() is not threading.main_thread():
+            return self
+        for sig in self._signals:
+            try:
+                self._prev[sig] = self._signal.signal(sig, self._on_signal)
+            except (ValueError, OSError):  # non-main thread / exotic sig
+                pass
+        return self
+
+    def _on_signal(self, signum, frame) -> None:
+        self._requested.set()
+        print(
+            f"[tadnn] received signal {signum}: draining — will "
+            f"checkpoint and exit after the current step",
+            file=sys.stderr, flush=True,
+        )
+
+    def request(self) -> None:
+        """Trip the drain flag programmatically (tests, cluster agents)."""
+        self._requested.set()
+
+    @property
+    def requested(self) -> bool:
+        return self._requested.is_set()
+
+    def uninstall(self) -> None:
+        for sig, prev in self._prev.items():
+            try:
+                self._signal.signal(sig, prev)
+            except (ValueError, OSError):
+                pass
+        self._prev.clear()
+
+    def __enter__(self) -> "PreemptionGuard":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
 
 
 def run_with_recovery(

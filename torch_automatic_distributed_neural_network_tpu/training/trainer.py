@@ -45,6 +45,7 @@ class TrainerConfig:
     heartbeat_dir: str = ""  # "" = off; shared-dir liveness beats
     eval_every: int = 0  # 0 = off; run evaluate(eval_data) every N steps
     eval_batches: int = 8  # batches per periodic evaluation
+    preempt_drain: bool = True  # SIGTERM -> checkpoint + clean return
 
 
 def _is_step_indexed(data: Any) -> bool:
@@ -77,6 +78,7 @@ class Trainer:
         self.run_config = run_config
         self.callbacks = list(callbacks or [])
         self.eval_data = eval_data
+        self.preempt = None  # PreemptionGuard, installed during fit()
 
     def evaluate(
         self, data: Any, n_batches: int, *, state: "TrainState",
@@ -143,7 +145,7 @@ class Trainer:
         else:
             start = int(state.step)
 
-        from .elastic import Heartbeat, StepWatchdog
+        from .elastic import Heartbeat, PreemptionGuard, StepWatchdog
 
         # The watchdog is armed after the first step completes: the first
         # step includes jit compilation (minutes for big models), which a
@@ -151,6 +153,8 @@ class Trainer:
         watchdog: StepWatchdog | None = None
         heartbeat = (Heartbeat(cfg.heartbeat_dir).start()
                      if cfg.heartbeat_dir else None)
+        self.preempt = (PreemptionGuard().install()
+                        if cfg.preempt_drain else None)
         try:
             if self.metrics:
                 self.metrics.start_step()
@@ -214,6 +218,24 @@ class Trainer:
                     slow_block = True
                 for cb in self.callbacks:
                     cb(i + 1, state, step_metrics)
+                if self.preempt is not None and self._drain_agreed():
+                    # graceful drain: save where we are and return; the
+                    # recovery path (restore_or_init / run_with_recovery)
+                    # resumes from exactly this step on the next start
+                    if self.ckpt:
+                        # the periodic block above may have saved this
+                        # very step; orbax refuses to overwrite it
+                        if self.ckpt.latest_step() != i + 1:
+                            self.ckpt.save(i + 1, state,
+                                           config=self.run_config,
+                                           force=True)
+                        self.ckpt.wait()
+                    if jax.process_index() == 0:
+                        print(f"preemption drain: stopped after step "
+                              f"{i + 1}"
+                              + (", checkpoint saved" if self.ckpt
+                                 else " (no checkpoint manager)"))
+                    return state
                 if slow_block and self.metrics:
                     # eval/checkpoint wall time must not bleed into the
                     # next training record's step_time/MFU
@@ -236,11 +258,32 @@ class Trainer:
                 watchdog.stop()
             if heartbeat:
                 heartbeat.stop()
+            if self.preempt is not None:
+                self.preempt.uninstall()
             if self.ckpt:
                 # barrier for in-flight async saves: a recovery restart
                 # must not race the pending commit (elastic.py)
                 self.ckpt.wait()
         return state
+
+    def _drain_agreed(self) -> bool:
+        """Cross-host agreement on the preemption drain.
+
+        Each host sees only its own SIGTERM, and signals can land on
+        opposite sides of a step boundary — hosts must agree on WHICH
+        step to stop after, or they run mismatched collectives and hang
+        through the grace window.  Single-process: just the local flag.
+        Multi-host: allgather-OR the flag every step (one tiny host
+        collective; worth it — a hung drain saves nothing at all).
+        """
+        if jax.process_count() == 1:
+            return self.preempt.requested
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.asarray(self.preempt.requested)
+        )
+        return bool(np.asarray(flags).any())
 
     # -- guards -------------------------------------------------------------
 
