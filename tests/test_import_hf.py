@@ -1,0 +1,141 @@
+"""HF/torch weight import (models/import_hf.py): logits parity against
+the REAL transformers implementations — the strongest "switch from the
+torch reference and keep your weights" proof available offline (random
+init; no network, no downloaded checkpoints)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+transformers = pytest.importorskip("transformers")
+
+from torch_automatic_distributed_neural_network_tpu.models import (  # noqa: E402
+    import_hf_gpt2,
+    import_hf_llama,
+)
+
+
+def _logits_ours(model, variables, tokens):
+    return np.asarray(
+        jax.jit(model.apply)(variables, jnp.asarray(tokens))
+    )
+
+
+def test_gpt2_logits_match_transformers():
+    cfg = transformers.GPT2Config(
+        vocab_size=160, n_positions=64, n_embd=128, n_layer=3, n_head=2,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+    )
+    torch.manual_seed(0)
+    hf = transformers.GPT2LMHeadModel(cfg).eval()
+    model, variables = import_hf_gpt2(hf, dtype=jnp.float32)
+    assert model.cfg.n_layers == 3 and model.cfg.d_model == 128
+    tokens = np.random.RandomState(1).randint(0, 160, (2, 17))
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens)).logits.numpy()
+    got = _logits_ours(model, variables, tokens)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_gpt2_head_count_sources():
+    """n_heads comes from the attached config when present (here 8,
+    which the d/64 rule would get wrong); a raw state_dict falls back
+    to the GPT-2 family rule d/64."""
+    cfg = transformers.GPT2Config(
+        vocab_size=64, n_positions=32, n_embd=256, n_layer=1, n_head=8,
+    )
+    hf = transformers.GPT2LMHeadModel(cfg)
+    model, _ = import_hf_gpt2(hf)
+    assert model.cfg.n_heads == 8  # from config, not 256/64
+    model2, _ = import_hf_gpt2(hf.state_dict())
+    assert model2.cfg.n_heads == 4  # raw dict: d/64 fallback
+
+
+def test_llama_logits_match_transformers():
+    cfg = transformers.LlamaConfig(
+        vocab_size=160, hidden_size=128, intermediate_size=224,
+        num_hidden_layers=3, num_attention_heads=4,
+        num_key_value_heads=2,  # GQA
+        max_position_embeddings=64, rms_norm_eps=1e-5,
+        rope_theta=10000.0, attention_dropout=0.0, tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    hf = transformers.LlamaForCausalLM(cfg).eval()
+    model, variables = import_hf_llama(hf, max_seq_len=64,
+                                       dtype=jnp.float32)
+    assert model.cfg.n_kv_heads == 2 and model.cfg.d_ff == 224
+    assert model.cfg.tie_embeddings is False
+    tokens = np.random.RandomState(2).randint(0, 160, (2, 19))
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens)).logits.numpy()
+    got = _logits_ours(model, variables, tokens)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_llama_bare_model_imports_as_tied():
+    """A bare LlamaModel has no LM head regardless of its config's
+    tie_word_embeddings default — absence means tied."""
+    cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=1, num_attention_heads=2,
+        num_key_value_heads=2, max_position_embeddings=32,
+        tie_word_embeddings=False,
+    )
+    model, variables = import_hf_llama(transformers.LlamaModel(cfg),
+                                       max_seq_len=32)
+    assert model.cfg.tie_embeddings is True
+    assert "lm_head" not in variables["params"]
+
+
+def test_llama_tied_embeddings():
+    cfg = transformers.LlamaConfig(
+        vocab_size=96, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=2,
+        num_key_value_heads=2, max_position_embeddings=32,
+        rms_norm_eps=1e-5, tie_word_embeddings=True,
+    )
+    torch.manual_seed(3)
+    hf = transformers.LlamaForCausalLM(cfg).eval()
+    model, variables = import_hf_llama(hf, max_seq_len=32,
+                                       dtype=jnp.float32)
+    assert model.cfg.tie_embeddings is True
+    assert "lm_head" not in variables["params"]
+    tokens = np.random.RandomState(4).randint(0, 96, (1, 11))
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens)).logits.numpy()
+    got = _logits_ours(model, variables, tokens)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_imported_model_trains_distributed(devices8):
+    """The imported tree drops straight into AutoDistribute: shard it
+    over the 8-device mesh and take optimizer steps."""
+    import optax
+
+    import torch_automatic_distributed_neural_network_tpu as tad
+    from torch_automatic_distributed_neural_network_tpu.training import (
+        next_token_loss,
+    )
+
+    cfg = transformers.GPT2Config(
+        vocab_size=96, n_positions=32, n_embd=64, n_layer=2, n_head=1,
+    )
+    torch.manual_seed(5)
+    hf = transformers.GPT2LMHeadModel(cfg)
+    model, variables = import_hf_gpt2(hf, dtype=jnp.float32)
+    ad = tad.AutoDistribute(
+        model,
+        optimizer=optax.adamw(1e-3),
+        loss_fn=next_token_loss,
+        strategy="dp",
+        init_fn=lambda rng, batch: variables,
+    )
+    batch = {"tokens": np.random.RandomState(6).randint(0, 96, (8, 17))}
+    state = ad.init(jax.random.key(0), batch)
+    losses = []
+    for _ in range(3):
+        state, m = ad.step(state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]  # it learns from the imported weights

@@ -143,9 +143,7 @@ def cmd_fit(args: argparse.Namespace) -> int:
             print(json.dumps({"error": "backend exposes no analysis"}))
             return 1
         # same budget the search ladder measures against
-        from . import planner as planner_mod
-
-        budget = AutoDistribute._SEARCH_SAFETY * planner_mod._hbm_bytes(
+        budget = AutoDistribute.hbm_fit_budget(
             jax.devices()[0].device_kind
         )
         entries = [{

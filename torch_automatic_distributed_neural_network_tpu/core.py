@@ -280,6 +280,15 @@ class AutoDistribute:
     )
     _SEARCH_SAFETY = 0.92  # accept a plan at <= this fraction of HBM
 
+    @staticmethod
+    def hbm_fit_budget(device_kind: str) -> float:
+        """The byte budget a measured plan must fit (search ladder and
+        `tadnn fit` both compare against this): the per-chip HBM table
+        entry scaled by the safety margin."""
+        return AutoDistribute._SEARCH_SAFETY * planner_mod._hbm_bytes(
+            device_kind
+        )
+
     def _search_plan(self, rng: jax.Array, sample_batch: Any):
         """Measurement-validated strategy selection (``strategy='search'``).
 
@@ -320,9 +329,7 @@ class AutoDistribute:
             if planner_mod.detect_expert_count(abstract)
             else self._SEARCH_LADDER_DENSE
         )
-        budget = self._SEARCH_SAFETY * planner_mod._hbm_bytes(
-            devices[0].device_kind
-        )
+        budget = self.hbm_fit_budget(devices[0].device_kind)
         if orig_remat is not None:
             # an explicit user remat= overrides the ladder's escalation
             # dimension: measure every rung with the user's setting

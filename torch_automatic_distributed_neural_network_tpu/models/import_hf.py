@@ -1,0 +1,272 @@
+"""HuggingFace / PyTorch weight import — the migration path.
+
+The reference's users hold torch checkpoints (SURVEY.md §0: the reference
+is a thin wrapper over stock PyTorch models).  These functions map the
+two marquee decoder layouts — HF GPT-2 and HF Llama — onto this
+framework's flax parameter trees, so a reference user can load their
+existing weights and keep training/serving on TPU:
+
+    import transformers
+    hf = transformers.GPT2LMHeadModel.from_pretrained(path)
+    model, variables = import_hf_gpt2(hf)
+    ad = AutoDistribute(model, ...)
+    state = ad.init(...)             # then graft variables in, or:
+    ad.step(state_with(variables), batch)
+
+Numerical conventions line up by construction (pinned by
+tests/test_torch_crosscheck.py and tests/test_import_hf.py):
+
+- our ``rope`` is the rotate-half formulation HF Llama uses — weights
+  import with NO channel permutation;
+- ``nn.gelu`` (tanh approximation) == HF ``gelu_new``;
+- LayerNorm/RMSNorm epsilon 1e-5 == GPT-2's ``layer_norm_epsilon`` and
+  Llama-3's ``rms_norm_eps``;
+- HF GPT-2 uses Conv1D ([in, out] weights — our kernel orientation,
+  no transpose); HF Llama uses nn.Linear ([out, in] — transposed here).
+
+Everything works on detached CPU tensors; no torch is imported until a
+function is called.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+from .transformer_core import DecoderLM, TransformerConfig
+
+
+def _np(t) -> np.ndarray:
+    """torch tensor (or array) -> float32 numpy on host."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().float().numpy()
+    return np.asarray(t, dtype=np.float32)
+
+
+def _state_dict(model_or_sd) -> Mapping[str, Any]:
+    # bare-vs-LM-headed prefix differences ("transformer."/"model.") are
+    # handled by _get's dual-name lookups, not here
+    sd = (model_or_sd.state_dict()
+          if hasattr(model_or_sd, "state_dict") else model_or_sd)
+    return dict(sd)
+
+
+def _get(sd: Mapping[str, Any], *names: str) -> np.ndarray:
+    for n in names:
+        if n in sd:
+            return _np(sd[n])
+    raise KeyError(
+        f"none of {names} in state_dict (have e.g. "
+        f"{list(sd)[:5]}...)"
+    )
+
+
+def _stack(layers: list[dict]) -> dict:
+    """[{leaf: array}] per layer -> {leaf: [L, ...] array} (scan layout)."""
+    import jax
+
+    return jax.tree.map(lambda *xs: np.stack(xs, axis=0), *layers)
+
+
+def import_hf_gpt2(
+    model_or_state_dict, *, max_seq_len: int | None = None,
+    dtype: Any = None,
+) -> tuple[DecoderLM, dict]:
+    """HF ``GPT2LMHeadModel`` / ``GPT2Model`` -> (our GPT2, variables).
+
+    Reads dims from the weights themselves (no config object needed):
+    wte [V, d], wpe [P, d], per-block c_attn [d, 3d] fused qkv.
+    """
+    sd = _state_dict(model_or_state_dict)
+
+    def g(name):
+        return _get(sd, f"transformer.{name}", name)
+
+    wte = g("wte.weight")
+    wpe = g("wpe.weight")
+    vocab, d = wte.shape
+    n_layers = 0
+    while f"transformer.h.{n_layers}.ln_1.weight" in sd or (
+        f"h.{n_layers}.ln_1.weight" in sd
+    ):
+        n_layers += 1
+    # head count is not recoverable from the weights (qkv is fused);
+    # read it from an attached config, falling back to the GPT-2 family
+    # rule of d/64 for raw state_dicts
+    hf_cfg = getattr(model_or_state_dict, "config", None)
+    if hf_cfg is not None and getattr(hf_cfg, "n_head", None):
+        n_heads = int(hf_cfg.n_head)
+    else:
+        n_heads = max(1, d // 64)
+    hd = d // n_heads
+    cfg = TransformerConfig(
+        vocab_size=vocab,
+        d_model=d,
+        n_layers=n_layers,
+        n_heads=n_heads,
+        max_seq_len=max_seq_len or wpe.shape[0],
+        norm="layernorm",
+        act="gelu",
+        pos="learned",
+        tie_embeddings=True,
+        **({"dtype": dtype} if dtype is not None else {}),
+    )
+    layers = []
+    for i in range(n_layers):
+        def L(name):
+            return g(f"h.{i}.{name}")
+
+        qkv_w = L("attn.c_attn.weight")  # Conv1D: [d, 3d]
+        qkv_b = L("attn.c_attn.bias")  # [3d]
+        qw, kw, vw = np.split(qkv_w, 3, axis=1)
+        qb, kb, vb = np.split(qkv_b, 3, axis=0)
+        layers.append({
+            "attn_norm": {"scale": L("ln_1.weight"),
+                          "bias": L("ln_1.bias")},
+            "attn": {
+                "q_proj": {"kernel": qw.reshape(d, n_heads, hd),
+                           "bias": qb.reshape(n_heads, hd)},
+                "k_proj": {"kernel": kw.reshape(d, n_heads, hd),
+                           "bias": kb.reshape(n_heads, hd)},
+                "v_proj": {"kernel": vw.reshape(d, n_heads, hd),
+                           "bias": vb.reshape(n_heads, hd)},
+                "o_proj": {
+                    "kernel": L("attn.c_proj.weight").reshape(
+                        n_heads, hd, d
+                    ),
+                    "bias": L("attn.c_proj.bias"),
+                },
+            },
+            "mlp_norm": {"scale": L("ln_2.weight"),
+                         "bias": L("ln_2.bias")},
+            "mlp": {
+                "up_proj": {"kernel": L("mlp.c_fc.weight"),
+                            "bias": L("mlp.c_fc.bias")},
+                "down_proj": {"kernel": L("mlp.c_proj.weight"),
+                              "bias": L("mlp.c_proj.bias")},
+            },
+        })
+    params = {
+        "embed": {"embedding": wte},
+        "pos_embed": wpe,
+        "layers": _stack(layers),
+        "final_norm": {"scale": g("ln_f.weight"), "bias": g("ln_f.bias")},
+    }
+    return DecoderLM(cfg), {"params": params}
+
+
+def import_hf_llama(
+    model_or_state_dict, *, max_seq_len: int = 8192,
+    rope_theta: float | None = None, dtype: Any = None,
+) -> tuple[DecoderLM, dict]:
+    """HF ``LlamaForCausalLM`` / ``LlamaModel`` -> (our Llama, variables).
+
+    torch ``nn.Linear`` stores ``[out, in]``; every projection transposes
+    into our ``[in, ...]`` kernels.  GQA dims are read from the k_proj
+    shape.  ``rope_theta`` defaults from the model config when one is
+    attached (HF Llama-3 uses 500000.0), else 10000.0.
+    """
+    sd = _state_dict(model_or_state_dict)
+    hf_cfg = getattr(model_or_state_dict, "config", None)
+    if rope_theta is None:
+        rope_theta = float(getattr(hf_cfg, "rope_theta", 10000.0))
+
+    def g(name):
+        return _get(sd, f"model.{name}", name)
+
+    emb = g("embed_tokens.weight")
+    vocab, d = emb.shape
+    n_layers = 0
+    while (f"model.layers.{n_layers}.input_layernorm.weight" in sd
+           or f"layers.{n_layers}.input_layernorm.weight" in sd):
+        n_layers += 1
+    q0 = g("layers.0.self_attn.q_proj.weight")  # [H*hd, d]
+    k0 = g("layers.0.self_attn.k_proj.weight")  # [KV*hd, d]
+    ff = g("layers.0.mlp.gate_proj.weight").shape[0]
+    # head counts: from the attached config when present; raw
+    # state_dicts fall back to the Llama-family head_dim convention
+    # (128 for the 8B/70B-scale widths, 64 below)
+    if hf_cfg is not None and hasattr(hf_cfg, "num_attention_heads"):
+        n_heads = int(hf_cfg.num_attention_heads)
+        n_kv = int(getattr(hf_cfg, "num_key_value_heads", n_heads))
+    else:
+        hd_guess = 128 if d >= 2048 else 64
+        n_heads = q0.shape[0] // hd_guess
+        n_kv = k0.shape[0] // hd_guess
+    hd = q0.shape[0] // n_heads
+    # HF materializes lm_head.weight in state_dict() even when tied (it
+    # is the same storage as embed_tokens).  A bare LlamaModel has no
+    # head at all regardless of what its config claims — absence always
+    # means tied; with a head present, trust the config, else value-
+    # identity against the embedding.
+    head = next(
+        (sd[k] for k in ("lm_head.weight", "model.lm_head.weight")
+         if k in sd), None
+    )
+    if head is None:
+        tied = True
+    elif hf_cfg is not None and hasattr(hf_cfg, "tie_word_embeddings"):
+        tied = bool(hf_cfg.tie_word_embeddings)
+    else:
+        tied = np.array_equal(_np(head), emb)
+    cfg = TransformerConfig(
+        vocab_size=vocab,
+        d_model=d,
+        n_layers=n_layers,
+        n_heads=n_heads,
+        n_kv_heads=n_kv,
+        d_ff=ff,
+        max_seq_len=max_seq_len,
+        norm="rmsnorm",
+        act="swiglu",
+        pos="rope",
+        tie_embeddings=tied,
+        rope_theta=rope_theta,
+        **({"dtype": dtype} if dtype is not None else {}),
+    )
+
+    def lin(w, out_shape):
+        """torch Linear [out, in] -> our kernel [in, *out_shape]."""
+        return np.ascontiguousarray(w.T).reshape((w.shape[1],) + out_shape)
+
+    layers = []
+    for i in range(n_layers):
+        def L(name):
+            return g(f"layers.{i}.{name}")
+
+        o_w = L("self_attn.o_proj.weight")  # [d, H*hd]
+        layers.append({
+            "attn_norm": {"scale": L("input_layernorm.weight")},
+            "attn": {
+                "q_proj": {"kernel": lin(L("self_attn.q_proj.weight"),
+                                         (n_heads, hd))},
+                "k_proj": {"kernel": lin(L("self_attn.k_proj.weight"),
+                                         (n_kv, hd))},
+                "v_proj": {"kernel": lin(L("self_attn.v_proj.weight"),
+                                         (n_kv, hd))},
+                # [d, H*hd] -> [H, hd, d]
+                "o_proj": {"kernel": np.ascontiguousarray(o_w.T).reshape(
+                    n_heads, hd, d
+                )},
+            },
+            "mlp_norm": {"scale": L("post_attention_layernorm.weight")},
+            "mlp": {
+                "gate_proj": {"kernel": lin(L("mlp.gate_proj.weight"),
+                                            (ff,))},
+                "up_proj": {"kernel": lin(L("mlp.up_proj.weight"),
+                                          (ff,))},
+                "down_proj": {"kernel": lin(L("mlp.down_proj.weight"),
+                                            (d,))},
+            },
+        })
+    params = {
+        "embed": {"embedding": emb},
+        "layers": _stack(layers),
+        "final_norm": {"scale": g("norm.weight")},
+    }
+    if not tied:
+        params["lm_head"] = {"kernel": np.ascontiguousarray(
+            _get(sd, "lm_head.weight", "model.lm_head.weight").T
+        )}
+    return DecoderLM(cfg), {"params": params}
