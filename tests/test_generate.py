@@ -88,6 +88,37 @@ def test_sampled_generate_is_deterministic_per_key():
     np.testing.assert_array_equal(np.asarray(a[:, :4]), np.asarray(tokens))
 
 
+def test_top_p_filters_tail():
+    """Nucleus sampling: with probs [.5, .3, .15, .05] and top_p=0.7 only
+    tokens {0, 1} are in the nucleus (cumulative mass before each is 0
+    and .5 < .7; token 2's is .8 — a 0.1 margin from the threshold, so
+    float32 reduction-order wiggle can't flip the verdict), so the tail
+    never appears; top_p=1 leaves the distribution intact (token 3
+    eventually shows up)."""
+    import jax
+
+    from torch_automatic_distributed_neural_network_tpu.inference.decode import (
+        _sample,
+    )
+
+    probs = np.array([[0.5, 0.3, 0.15, 0.05]], np.float32)
+    logits = jnp.asarray(np.log(probs))
+    seen = set()
+    for i in range(200):
+        tok = _sample(logits, jax.random.key(i),
+                      SampleConfig(temperature=1.0, top_p=0.7))
+        seen.add(int(tok[0]))
+    assert seen == {0, 1}, seen
+    with pytest.raises(ValueError):
+        SampleConfig(top_p=0.0)
+    seen_full = set()
+    for i in range(500):
+        tok = _sample(logits, jax.random.key(i),
+                      SampleConfig(temperature=1.0, top_p=1.0))
+        seen_full.add(int(tok[0]))
+    assert 3 in seen_full
+
+
 def test_moe_greedy_generate_matches_naive_loop():
     """MoE decode (dispatch-free all-expert combine) == recompute-the-
     whole-prefix greedy loop through the training forward (no token drops

@@ -13,6 +13,7 @@ from .arrays import (
 from .loader import TokenFileDataset, shard_for_host, write_token_file
 from .text import ByteTokenizer, load_tokenizer, tokenize_file
 from .synthetic import SyntheticClassification, SyntheticLM
+from .torch_adapter import TorchDatasetAdapter, TorchLoaderAdapter
 
 __all__ = [
     "ArrayClassification",
@@ -29,4 +30,6 @@ __all__ = [
     "ByteTokenizer",
     "load_tokenizer",
     "tokenize_file",
+    "TorchDatasetAdapter",
+    "TorchLoaderAdapter",
 ]

@@ -1,0 +1,148 @@
+"""torch Dataset/DataLoader adapters — the data-side migration path.
+
+The reference's users hold ``torch.utils.data`` pipelines (SURVEY.md C13:
+torch ``DataLoader`` + ``DistributedSampler``).  Two adapters let them
+keep those pipelines unchanged:
+
+- :class:`TorchDatasetAdapter` wraps any map-style ``Dataset`` (anything
+  with ``__len__`` + ``__getitem__``) into this framework's
+  **step-indexed** protocol (``step_indexed = True``, ``.batch(i)``):
+  deterministic per-epoch shuffling keyed by (seed, epoch), so a resumed
+  run sees exactly the batches an uninterrupted run would have — the
+  elastic-parity property the Trainer documents.  This replaces
+  ``DistributedSampler`` outright: under the single-controller model
+  every host materializes the same global batch and
+  ``AutoDistribute.shard_batch`` / multi-host assembly splits it.
+- :class:`TorchLoaderAdapter` wraps an iterable ``DataLoader`` (or any
+  iterable of batches) as a plain iterable of host-numpy batches, for
+  pipelines whose sampling/augmentation lives in the loader itself.
+
+torch is imported lazily — the module is importable without torch
+installed; instantiating an adapter is what requires it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+
+def to_numpy_tree(x: Any) -> Any:
+    """torch tensors (recursively, through dict/list/tuple) -> numpy."""
+    if hasattr(x, "detach"):  # torch tensor, no torch import needed
+        return x.detach().cpu().numpy()
+    if isinstance(x, dict):
+        return {k: to_numpy_tree(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return type(x)(to_numpy_tree(v) for v in x)
+    return x
+
+
+def default_collate(items: Sequence[Any]) -> dict:
+    """Stack per-example items into the framework's dict-batch shape.
+
+    - dict items -> ``{key: stacked}``;
+    - ``(x, y)`` tuples (the torch classification convention) ->
+      ``{"x": ..., "label": ...}`` matching the CNN losses
+      (training/losses.py);
+    - single arrays -> ``{"x": ...}``.
+    """
+    first = to_numpy_tree(items[0])
+    items = [to_numpy_tree(i) for i in items]
+    if isinstance(first, dict):
+        return {k: np.stack([i[k] for i in items]) for k in first}
+    if isinstance(first, (list, tuple)):
+        if len(first) != 2:
+            raise ValueError(
+                f"default_collate handles (x, y) pairs; got "
+                f"{len(first)}-tuples — pass an explicit collate="
+            )
+        return {
+            "x": np.stack([i[0] for i in items]),
+            "label": np.stack([np.asarray(i[1]) for i in items]),
+        }
+    return {"x": np.stack(items)}
+
+
+class TorchDatasetAdapter:
+    """Map-style torch ``Dataset`` -> step-indexed batch source.
+
+    ``batch(step)`` draws batch ``step % steps_per_epoch`` of epoch
+    ``step // steps_per_epoch`` under a deterministic per-epoch
+    permutation — stateless, so checkpoint resume replays the exact
+    batch sequence (tests pin this).  Incomplete trailing batches are
+    dropped (``drop_last`` semantics), matching DistributedSampler's
+    default behavior.
+    """
+
+    step_indexed = True
+
+    def __init__(
+        self,
+        dataset: Any,
+        batch_size: int,
+        *,
+        shuffle: bool = True,
+        seed: int = 0,
+        collate: Callable[[Sequence[Any]], dict] | None = None,
+    ):
+        n = len(dataset)
+        if batch_size > n:
+            raise ValueError(f"batch_size {batch_size} > dataset size {n}")
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.collate = collate or default_collate
+        self.steps_per_epoch = n // batch_size
+
+    def _perm(self, epoch: int) -> np.ndarray:
+        from .arrays import _epoch_order
+
+        n = len(self.dataset)
+        if not self.shuffle:
+            return np.arange(n)
+        # same (seed, epoch) keying as the in-memory array sources, so
+        # all step-indexed adapters share one determinism scheme
+        return _epoch_order(n, epoch, self.seed)
+
+    def batch(self, step: int) -> dict:
+        epoch, k = divmod(step, self.steps_per_epoch)
+        idx = self._perm(epoch)[k * self.batch_size:(k + 1) * self.batch_size]
+        return self.collate([self.dataset[int(j)] for j in idx])
+
+    def __iter__(self):
+        step = 0
+        while True:
+            yield self.batch(step)
+            step += 1
+
+
+class TorchLoaderAdapter:
+    """Iterable ``DataLoader`` (or any batch iterable) -> iterable of
+    host-numpy dict batches.  Re-iterable iff the wrapped loader is
+    (DataLoaders are); tensors convert host-side, tuples map to the
+    ``{"x", "label"}`` convention via :func:`default_collate`'s rules.
+    """
+
+    step_indexed = False
+
+    def __init__(self, loader: Any):
+        self.loader = loader
+
+    def __iter__(self):
+        for batch in self.loader:
+            b = to_numpy_tree(batch)
+            if isinstance(b, dict):
+                yield b
+            elif isinstance(b, (list, tuple)):
+                if len(b) != 2:
+                    raise ValueError(
+                        f"TorchLoaderAdapter maps (x, y) pairs to "
+                        f"{{'x', 'label'}}; got a {len(b)}-tuple — wrap "
+                        f"your loader to yield dicts instead"
+                    )
+                yield {"x": np.asarray(b[0]), "label": np.asarray(b[1])}
+            else:
+                yield {"x": np.asarray(b)}

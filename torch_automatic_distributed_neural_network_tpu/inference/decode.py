@@ -193,6 +193,16 @@ def forward_cached(
 class SampleConfig:
     temperature: float = 1.0  # 0 -> greedy
     top_k: int = 0  # 0 -> full distribution
+    top_p: float = 1.0  # nucleus: keep the smallest set with mass >= p
+
+    def __post_init__(self):
+        if not 0.0 < self.top_p <= 1.0:
+            # top_p=0 would mask EVERY token and categorical would then
+            # silently emit id 0 forever; for greedy use temperature=0
+            raise ValueError(
+                f"top_p must be in (0, 1], got {self.top_p} "
+                f"(for greedy decoding use temperature=0)"
+            )
 
 
 def _sample(logits: jax.Array, rng: jax.Array, sc: SampleConfig) -> jax.Array:
@@ -202,6 +212,19 @@ def _sample(logits: jax.Array, rng: jax.Array, sc: SampleConfig) -> jax.Array:
     if sc.top_k:
         kth = jnp.sort(logits, -1)[:, -sc.top_k][:, None]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if sc.top_p < 1.0:
+        # nucleus filter (composes after top-k, the HF convention): keep
+        # the highest-probability tokens whose cumulative mass reaches p;
+        # the first token crossing the threshold is always kept
+        sorted_logits = jnp.sort(logits, -1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, -1)
+        cum = jnp.cumsum(probs, -1)
+        keep = cum - probs < sc.top_p  # mass BEFORE this token
+        # threshold = smallest kept logit per row
+        cutoff = jnp.min(
+            jnp.where(keep, sorted_logits, jnp.inf), -1, keepdims=True
+        )
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     return jax.random.categorical(rng, logits).astype(jnp.int32)
 
 

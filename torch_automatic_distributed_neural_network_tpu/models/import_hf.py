@@ -157,7 +157,7 @@ def import_hf_gpt2(
 
 
 def import_hf_llama(
-    model_or_state_dict, *, max_seq_len: int = 8192,
+    model_or_state_dict, *, max_seq_len: int | None = None,
     rope_theta: float | None = None, dtype: Any = None,
 ) -> tuple[DecoderLM, dict]:
     """HF ``LlamaForCausalLM`` / ``LlamaModel`` -> (our Llama, variables).
@@ -171,6 +171,12 @@ def import_hf_llama(
     hf_cfg = getattr(model_or_state_dict, "config", None)
     if rope_theta is None:
         rope_theta = float(getattr(hf_cfg, "rope_theta", 10000.0))
+    if max_seq_len is None:
+        # mirror import_hf_gpt2's wpe-derived default: the trained
+        # context length from the config, else a conservative 8192
+        max_seq_len = int(
+            getattr(hf_cfg, "max_position_embeddings", 8192) or 8192
+        )
 
     def g(name):
         return _get(sd, f"model.{name}", name)
