@@ -109,6 +109,36 @@ def test_llama_tied_embeddings():
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
 
 
+def test_mixtral_logits_match_transformers():
+    """MoE family: HF Mixtral (softmax-all -> top-k -> renormalize
+    router, per-expert w1/w3/w2) against our capacity-based expert
+    dispatch at the no-drop capacity bound."""
+    cfg = transformers.MixtralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, num_local_experts=4,
+        num_experts_per_tok=2, max_position_embeddings=64,
+        rms_norm_eps=1e-5, rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(7)
+    hf = transformers.MixtralForCausalLM(cfg).eval()
+    from torch_automatic_distributed_neural_network_tpu.models import (
+        import_hf_mixtral,
+    )
+
+    model, variables = import_hf_mixtral(hf, dtype=jnp.float32)
+    assert model.cfg.n_experts == 4 and model.cfg.top_k == 2
+    assert model.cfg.capacity_factor == 2.0  # E/top_k: no-drop bound
+    tokens = np.random.RandomState(8).randint(0, 128, (2, 13))
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens)).logits.numpy()
+    logits, _aux = jax.jit(model.apply)(variables, jnp.asarray(tokens))
+    np.testing.assert_allclose(
+        np.asarray(logits), ref, rtol=5e-4, atol=5e-4
+    )
+
+
 def test_imported_model_trains_distributed(devices8):
     """The imported tree drops straight into AutoDistribute: shard it
     over the 8-device mesh and take optimizer steps."""

@@ -29,11 +29,14 @@ import numpy as np
 
 
 def to_numpy_tree(x: Any) -> Any:
-    """torch tensors (recursively, through dict/list/tuple) -> numpy."""
+    """torch tensors (recursively, through dict/list/tuple/namedtuple)
+    -> numpy."""
     if hasattr(x, "detach"):  # torch tensor, no torch import needed
         return x.detach().cpu().numpy()
     if isinstance(x, dict):
         return {k: to_numpy_tree(v) for k, v in x.items()}
+    if isinstance(x, tuple) and hasattr(x, "_fields"):  # namedtuple
+        return type(x)(*(to_numpy_tree(v) for v in x))
     if isinstance(x, (list, tuple)):
         return type(x)(to_numpy_tree(v) for v in x)
     return x
@@ -96,6 +99,7 @@ class TorchDatasetAdapter:
         self.seed = seed
         self.collate = collate or default_collate
         self.steps_per_epoch = n // batch_size
+        self._perm_cache: tuple[int, np.ndarray] | None = None
 
     def _perm(self, epoch: int) -> np.ndarray:
         from .arrays import _epoch_order
@@ -103,9 +107,14 @@ class TorchDatasetAdapter:
         n = len(self.dataset)
         if not self.shuffle:
             return np.arange(n)
-        # same (seed, epoch) keying as the in-memory array sources, so
-        # all step-indexed adapters share one determinism scheme
-        return _epoch_order(n, epoch, self.seed)
+        # regenerating a full permutation per batch is O(n) host work on
+        # the hot data path; cache per epoch (still stateless: any
+        # (seed, epoch) regenerates identically on resume)
+        if self._perm_cache is None or self._perm_cache[0] != epoch:
+            # same (seed, epoch) keying as the in-memory array sources,
+            # so all step-indexed adapters share one determinism scheme
+            self._perm_cache = (epoch, _epoch_order(n, epoch, self.seed))
+        return self._perm_cache[1]
 
     def batch(self, step: int) -> dict:
         epoch, k = divmod(step, self.steps_per_epoch)

@@ -1,7 +1,7 @@
 """Model zoo mirroring the reference's example models (SURVEY.md C11/C12)."""
 
 from .gpt2 import GPT2, gpt2_config
-from .import_hf import import_hf_gpt2, import_hf_llama
+from .import_hf import import_hf_gpt2, import_hf_llama, import_hf_mixtral
 from .llama import Llama, llama_config
 from .mlp import MLP
 from .moe import MoE, MoEConfig, MoELM, moe_config
@@ -15,6 +15,7 @@ __all__ = [
     "gpt2_config",
     "import_hf_gpt2",
     "import_hf_llama",
+    "import_hf_mixtral",
     "Llama",
     "llama_config",
     "MoE",

@@ -156,6 +156,123 @@ def import_hf_gpt2(
     return DecoderLM(cfg), {"params": params}
 
 
+def _lin(w, out_shape: tuple = ()) -> np.ndarray:
+    """torch Linear [out, in] -> our kernel [in, *out_shape]."""
+    w = _np(w)
+    out_shape = out_shape or (w.shape[0],)
+    return np.ascontiguousarray(w.T).reshape((w.shape[1],) + out_shape)
+
+
+class _LlamaCommon:
+    """The dims/config/attention plumbing shared by every Llama-family
+    HF layout (Llama and Mixtral differ only in the MLP block)."""
+
+    def __init__(self, model_or_state_dict, max_seq_len, rope_theta=None):
+        sd = self.sd = _state_dict(model_or_state_dict)
+        hf_cfg = self.hf_cfg = getattr(model_or_state_dict, "config", None)
+        self.rope_theta = (
+            float(getattr(hf_cfg, "rope_theta", 10000.0))
+            if rope_theta is None else rope_theta
+        )
+        # the trained context length from the config, else a
+        # conservative 8192 (import_hf_gpt2 derives it from wpe instead)
+        self.max_seq_len = max_seq_len or int(
+            getattr(hf_cfg, "max_position_embeddings", 8192) or 8192
+        )
+        self.emb = self.g("embed_tokens.weight")
+        self.vocab, self.d = self.emb.shape
+        self.n_layers = 0
+        while (f"model.layers.{self.n_layers}.input_layernorm.weight" in sd
+               or f"layers.{self.n_layers}.input_layernorm.weight" in sd):
+            self.n_layers += 1
+        q0 = self.g("layers.0.self_attn.q_proj.weight")  # [H*hd, d]
+        k0 = self.g("layers.0.self_attn.k_proj.weight")  # [KV*hd, d]
+        # head counts: from the attached config when present; raw
+        # state_dicts fall back to the Llama-family head_dim convention
+        # (128 for the 8B/70B-scale widths, 64 below)
+        if hf_cfg is not None and hasattr(hf_cfg, "num_attention_heads"):
+            self.n_heads = int(hf_cfg.num_attention_heads)
+            self.n_kv = int(
+                getattr(hf_cfg, "num_key_value_heads", self.n_heads)
+            )
+        else:
+            hd_guess = 128 if self.d >= 2048 else 64
+            self.n_heads = q0.shape[0] // hd_guess
+            self.n_kv = k0.shape[0] // hd_guess
+        self.hd = q0.shape[0] // self.n_heads
+        # HF materializes lm_head.weight in state_dict() even when tied
+        # (same storage as embed_tokens).  A bare backbone has no head
+        # at all regardless of what its config claims — absence always
+        # means tied; with a head present, trust the config, else
+        # value-identity against the embedding.
+        head = next(
+            (sd[k] for k in ("lm_head.weight", "model.lm_head.weight")
+             if k in sd), None
+        )
+        if head is None:
+            self.tied = True
+        elif hf_cfg is not None and hasattr(hf_cfg, "tie_word_embeddings"):
+            self.tied = bool(hf_cfg.tie_word_embeddings)
+        else:
+            self.tied = np.array_equal(_np(head), self.emb)
+
+    def g(self, name):
+        return _get(self.sd, f"model.{name}", name)
+
+    def cfg_kwargs(self, dtype) -> dict:
+        return dict(
+            vocab_size=self.vocab,
+            d_model=self.d,
+            n_layers=self.n_layers,
+            n_heads=self.n_heads,
+            n_kv_heads=self.n_kv,
+            max_seq_len=self.max_seq_len,
+            norm="rmsnorm",
+            act="swiglu",
+            pos="rope",
+            tie_embeddings=self.tied,
+            rope_theta=self.rope_theta,
+            **({"dtype": dtype} if dtype is not None else {}),
+        )
+
+    def attn_and_norms(self, i: int) -> dict:
+        """One layer's attention + norm params (everything but mlp)."""
+
+        def L(name):
+            return self.g(f"layers.{i}.{name}")
+
+        o_w = L("self_attn.o_proj.weight")  # [d, H*hd]
+        return {
+            "attn_norm": {"scale": L("input_layernorm.weight")},
+            "attn": {
+                "q_proj": {"kernel": _lin(L("self_attn.q_proj.weight"),
+                                          (self.n_heads, self.hd))},
+                "k_proj": {"kernel": _lin(L("self_attn.k_proj.weight"),
+                                          (self.n_kv, self.hd))},
+                "v_proj": {"kernel": _lin(L("self_attn.v_proj.weight"),
+                                          (self.n_kv, self.hd))},
+                # [d, H*hd] -> [H, hd, d]
+                "o_proj": {"kernel": np.ascontiguousarray(
+                    _np(o_w).T
+                ).reshape(self.n_heads, self.hd, self.d)},
+            },
+            "mlp_norm": {"scale": L("post_attention_layernorm.weight")},
+        }
+
+    def assemble(self, layers: list[dict]) -> dict:
+        params = {
+            "embed": {"embedding": self.emb},
+            "layers": _stack(layers),
+            "final_norm": {"scale": self.g("norm.weight")},
+        }
+        if not self.tied:
+            params["lm_head"] = {"kernel": np.ascontiguousarray(
+                _get(self.sd, "lm_head.weight",
+                     "model.lm_head.weight").T
+            )}
+        return {"params": params}
+
+
 def import_hf_llama(
     model_or_state_dict, *, max_seq_len: int | None = None,
     rope_theta: float | None = None, dtype: Any = None,
@@ -167,112 +284,84 @@ def import_hf_llama(
     shape.  ``rope_theta`` defaults from the model config when one is
     attached (HF Llama-3 uses 500000.0), else 10000.0.
     """
-    sd = _state_dict(model_or_state_dict)
-    hf_cfg = getattr(model_or_state_dict, "config", None)
-    if rope_theta is None:
-        rope_theta = float(getattr(hf_cfg, "rope_theta", 10000.0))
-    if max_seq_len is None:
-        # mirror import_hf_gpt2's wpe-derived default: the trained
-        # context length from the config, else a conservative 8192
-        max_seq_len = int(
-            getattr(hf_cfg, "max_position_embeddings", 8192) or 8192
-        )
-
-    def g(name):
-        return _get(sd, f"model.{name}", name)
-
-    emb = g("embed_tokens.weight")
-    vocab, d = emb.shape
-    n_layers = 0
-    while (f"model.layers.{n_layers}.input_layernorm.weight" in sd
-           or f"layers.{n_layers}.input_layernorm.weight" in sd):
-        n_layers += 1
-    q0 = g("layers.0.self_attn.q_proj.weight")  # [H*hd, d]
-    k0 = g("layers.0.self_attn.k_proj.weight")  # [KV*hd, d]
-    ff = g("layers.0.mlp.gate_proj.weight").shape[0]
-    # head counts: from the attached config when present; raw
-    # state_dicts fall back to the Llama-family head_dim convention
-    # (128 for the 8B/70B-scale widths, 64 below)
-    if hf_cfg is not None and hasattr(hf_cfg, "num_attention_heads"):
-        n_heads = int(hf_cfg.num_attention_heads)
-        n_kv = int(getattr(hf_cfg, "num_key_value_heads", n_heads))
-    else:
-        hd_guess = 128 if d >= 2048 else 64
-        n_heads = q0.shape[0] // hd_guess
-        n_kv = k0.shape[0] // hd_guess
-    hd = q0.shape[0] // n_heads
-    # HF materializes lm_head.weight in state_dict() even when tied (it
-    # is the same storage as embed_tokens).  A bare LlamaModel has no
-    # head at all regardless of what its config claims — absence always
-    # means tied; with a head present, trust the config, else value-
-    # identity against the embedding.
-    head = next(
-        (sd[k] for k in ("lm_head.weight", "model.lm_head.weight")
-         if k in sd), None
-    )
-    if head is None:
-        tied = True
-    elif hf_cfg is not None and hasattr(hf_cfg, "tie_word_embeddings"):
-        tied = bool(hf_cfg.tie_word_embeddings)
-    else:
-        tied = np.array_equal(_np(head), emb)
-    cfg = TransformerConfig(
-        vocab_size=vocab,
-        d_model=d,
-        n_layers=n_layers,
-        n_heads=n_heads,
-        n_kv_heads=n_kv,
-        d_ff=ff,
-        max_seq_len=max_seq_len,
-        norm="rmsnorm",
-        act="swiglu",
-        pos="rope",
-        tie_embeddings=tied,
-        rope_theta=rope_theta,
-        **({"dtype": dtype} if dtype is not None else {}),
-    )
-
-    def lin(w, out_shape):
-        """torch Linear [out, in] -> our kernel [in, *out_shape]."""
-        return np.ascontiguousarray(w.T).reshape((w.shape[1],) + out_shape)
-
+    c = _LlamaCommon(model_or_state_dict, max_seq_len, rope_theta)
+    ff = c.g("layers.0.mlp.gate_proj.weight").shape[0]
+    cfg = TransformerConfig(d_ff=ff, **c.cfg_kwargs(dtype))
     layers = []
-    for i in range(n_layers):
+    for i in range(c.n_layers):
         def L(name):
-            return g(f"layers.{i}.{name}")
+            return c.g(f"layers.{i}.{name}")
 
-        o_w = L("self_attn.o_proj.weight")  # [d, H*hd]
         layers.append({
-            "attn_norm": {"scale": L("input_layernorm.weight")},
-            "attn": {
-                "q_proj": {"kernel": lin(L("self_attn.q_proj.weight"),
-                                         (n_heads, hd))},
-                "k_proj": {"kernel": lin(L("self_attn.k_proj.weight"),
-                                         (n_kv, hd))},
-                "v_proj": {"kernel": lin(L("self_attn.v_proj.weight"),
-                                         (n_kv, hd))},
-                # [d, H*hd] -> [H, hd, d]
-                "o_proj": {"kernel": np.ascontiguousarray(o_w.T).reshape(
-                    n_heads, hd, d
-                )},
-            },
-            "mlp_norm": {"scale": L("post_attention_layernorm.weight")},
+            **c.attn_and_norms(i),
             "mlp": {
-                "gate_proj": {"kernel": lin(L("mlp.gate_proj.weight"),
-                                            (ff,))},
-                "up_proj": {"kernel": lin(L("mlp.up_proj.weight"),
-                                          (ff,))},
-                "down_proj": {"kernel": lin(L("mlp.down_proj.weight"),
-                                            (d,))},
+                "gate_proj": {"kernel": _lin(L("mlp.gate_proj.weight"))},
+                "up_proj": {"kernel": _lin(L("mlp.up_proj.weight"))},
+                "down_proj": {"kernel": _lin(L("mlp.down_proj.weight"))},
             },
         })
-    params = {
-        "embed": {"embedding": emb},
-        "layers": _stack(layers),
-        "final_norm": {"scale": g("norm.weight")},
-    }
-    if not tied:
-        params["lm_head"] = {"kernel": np.ascontiguousarray(
-            _get(sd, "lm_head.weight", "model.lm_head.weight").T
-        )}
-    return DecoderLM(cfg), {"params": params}
+    return DecoderLM(cfg), c.assemble(layers)
+
+
+def import_hf_mixtral(
+    model_or_state_dict, *, max_seq_len: int | None = None,
+    capacity_factor: float | None = None, dtype: Any = None,
+):
+    """HF ``MixtralForCausalLM`` / ``MixtralModel`` -> (our MoELM,
+    variables).
+
+    Attention/norm layout is Llama's; the sparse-MoE block maps
+    ``block_sparse_moe.gate`` -> router, and per-expert ``w1/w3/w2``
+    (gate/up/down, all ``nn.Linear`` [out, in]) -> the stacked
+    ``experts_gate/up/down`` banks.  Router numerics line up: both
+    sides softmax over ALL experts, take top-k, renormalize.
+
+    ``capacity_factor`` defaults to ``n_experts / top_k`` — the exact
+    no-drop bound — because HF Mixtral never drops tokens and dropping
+    would break logits parity; lower it for capacity-constrained
+    training after import.
+    """
+    from .moe import MoEConfig, MoELM
+
+    c = _LlamaCommon(model_or_state_dict, max_seq_len)
+    n_experts = 0
+    while (f"model.layers.0.block_sparse_moe.experts.{n_experts}.w1.weight"
+           in c.sd
+           or f"layers.0.block_sparse_moe.experts.{n_experts}.w1.weight"
+           in c.sd):
+        n_experts += 1
+    ff = c.g("layers.0.block_sparse_moe.experts.0.w1.weight").shape[0]
+    top_k = int(getattr(c.hf_cfg, "num_experts_per_tok", 2) or 2)
+    cfg = MoEConfig(
+        d_ff=ff,
+        n_experts=n_experts,
+        top_k=top_k,
+        capacity_factor=(
+            capacity_factor if capacity_factor is not None
+            else n_experts / top_k
+        ),
+        **c.cfg_kwargs(dtype),
+    )
+    layers = []
+    for i in range(c.n_layers):
+        def L(name):
+            return c.g(f"layers.{i}.{name}")
+
+        def expert_bank(w_name):
+            return np.stack([
+                _lin(L(f"block_sparse_moe.experts.{e}.{w_name}.weight"))
+                for e in range(n_experts)
+            ])
+
+        layers.append({
+            **c.attn_and_norms(i),
+            "mlp": {
+                "router": {"kernel": _lin(
+                    L("block_sparse_moe.gate.weight")
+                )},
+                "experts_gate": expert_bank("w1"),  # [E, d, ff]
+                "experts_up": expert_bank("w3"),
+                "experts_down": expert_bank("w2"),  # [E, ff, d]
+            },
+        })
+    return MoELM(cfg), c.assemble(layers)
