@@ -41,6 +41,7 @@ class RunCfg:
     new_tokens: int = 64
     temperature: float = 0.8
     top_k: int = 40
+    top_p: float = 1.0  # nucleus sampling; 1.0 = off
     # 'none' -> plain single-program decode; any planner strategy
     # ('tp', 'tp_fsdp', 'fsdp', 'dp') -> plan-aware sharded decode
     # (AutoDistribute.generate: sharded params, KV cache on the mesh)
@@ -65,7 +66,8 @@ def main():
         jnp.int32,
     )
     variables = model.init(jax.random.key(0), prompt)
-    sample = SampleConfig(temperature=r.temperature, top_k=r.top_k)
+    sample = SampleConfig(temperature=r.temperature, top_k=r.top_k,
+                          top_p=r.top_p)
 
     if r.strategy != "none":
         import optax

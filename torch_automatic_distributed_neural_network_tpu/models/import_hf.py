@@ -2,9 +2,9 @@
 
 The reference's users hold torch checkpoints (SURVEY.md §0: the reference
 is a thin wrapper over stock PyTorch models).  These functions map the
-two marquee decoder layouts — HF GPT-2 and HF Llama — onto this
-framework's flax parameter trees, so a reference user can load their
-existing weights and keep training/serving on TPU:
+three marquee decoder layouts — HF GPT-2, HF Llama, and HF Mixtral
+(MoE) — onto this framework's flax parameter trees, so a reference user
+can load their existing weights and keep training/serving on TPU:
 
     import transformers
     hf = transformers.GPT2LMHeadModel.from_pretrained(path)
@@ -22,7 +22,11 @@ tests/test_torch_crosscheck.py and tests/test_import_hf.py):
 - LayerNorm/RMSNorm epsilon 1e-5 == GPT-2's ``layer_norm_epsilon`` and
   Llama-3's ``rms_norm_eps``;
 - HF GPT-2 uses Conv1D ([in, out] weights — our kernel orientation,
-  no transpose); HF Llama uses nn.Linear ([out, in] — transposed here).
+  no transpose); HF Llama/Mixtral use nn.Linear ([out, in] — transposed
+  here);
+- both MoE routers softmax over ALL experts, take top-k, renormalize;
+  Mixtral imports at the no-drop capacity bound (E/top_k) so our
+  capacity-based dispatch cannot drop what HF would keep.
 
 Everything works on detached CPU tensors; no torch is imported until a
 function is called.
@@ -305,6 +309,7 @@ def import_hf_llama(
 
 def import_hf_mixtral(
     model_or_state_dict, *, max_seq_len: int | None = None,
+    rope_theta: float | None = None,
     capacity_factor: float | None = None, dtype: Any = None,
 ):
     """HF ``MixtralForCausalLM`` / ``MixtralModel`` -> (our MoELM,
@@ -323,7 +328,10 @@ def import_hf_mixtral(
     """
     from .moe import MoEConfig, MoELM
 
-    c = _LlamaCommon(model_or_state_dict, max_seq_len)
+    # raw Mixtral state_dicts need the override: every released Mixtral
+    # uses rope_theta=1e6, but without an attached config the fallback
+    # is the Llama default 1e4
+    c = _LlamaCommon(model_or_state_dict, max_seq_len, rope_theta)
     n_experts = 0
     while (f"model.layers.0.block_sparse_moe.experts.{n_experts}.w1.weight"
            in c.sd
