@@ -41,7 +41,21 @@ SPMD programs — each pipe rank takes its own branch, and the tensor/data
 auto-axis peers of a rank agree on the predicate, so collectives inside
 the taken branch stay consistent).  ``schedule='dense'`` keeps the
 round-2 compute-everything-and-mask behavior for A/B measurement
-(bench.py mode=pipeline records the gap).
+(bench.py mode=pipeline records the gap).  ``schedule='1f1b'`` replaces
+AD-through-the-scan with a hand-scheduled backward (onef_oneb_grads):
+M-independent live-activation memory.
+
+Not implemented (design note for a future round): the Megatron
+*interleaved* schedule — V virtual stages per device, bubble fraction
+shrinking to ~(S-1)/(VM+S-1).  The layout that makes it free of weight
+movement: view the stacked ``[L, ...]`` layer dim as ``[V, S, C]``
+(pure reshape — natural layer (vS+s)C+j lands at index (v, s, j)) and
+shard dim 1 on ``pipe``; each device then holds exactly its V
+round-robin blocks with NO gather/all-to-all, and the ring permutation
+(i -> i+1) already visits virtual stages in order.  The costs that kept
+it out of this round: the train-state layout changes rank (checkpoints
+/ decode paths need a reshape-aware spec), and each lockstep tick runs
+up to V stage blocks, so the scan body and the stash ring grow V-fold.
 """
 
 from __future__ import annotations
