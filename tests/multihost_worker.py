@@ -16,8 +16,11 @@ oracle uses.  Exercises the full multi-host stack for real — no mocks:
 - Orbax checkpoint save + restore across the process world.
 
 Prints one JSON line: {"process": i, "losses": [...], "restored_ok": b,
-"n_devices": N, "n_local": n}.  The parent asserts both processes agree
-and that the trajectory matches a single-process 8-device oracle.
+"restored_step": s, "drain_before": b, "drain_agreed": b,
+"n_devices": N, "n_local": n} — the drain pair exercises
+``Trainer._drain_agreed``'s allgather-OR with only host 0 signaled.
+The parent asserts both processes agree and that the trajectory matches
+a single-process 8-device oracle.
 """
 
 import json
@@ -85,11 +88,33 @@ def main():
     )
     restored_ok = max(jax.tree.leaves(diffs)) == 0.0
 
+    # Preemption drain agreement (trainer._drain_agreed): only THIS
+    # world's host 0 "receives SIGTERM" — the asymmetric case where an
+    # unsynchronized drain would run mismatched collectives — and both
+    # hosts must still agree to stop (allgather-OR of the flags).
+    from torch_automatic_distributed_neural_network_tpu.training import (
+        Trainer,
+        TrainerConfig,
+    )
+    from torch_automatic_distributed_neural_network_tpu.training.elastic import (
+        PreemptionGuard,
+    )
+
+    trainer = Trainer(ad, TrainerConfig(steps=1, preempt_drain=False))
+    trainer.preempt = PreemptionGuard()  # not installed; flag-only
+    # no host signaled -> no drain (falsifies a degenerately-True helper)
+    drain_before = trainer._drain_agreed()
+    if pid == 0:
+        trainer.preempt.request()
+    drain_agreed = trainer._drain_agreed()
+
     print(json.dumps({
         "process": pid,
         "losses": losses,
         "restored_ok": bool(restored_ok),
         "restored_step": int(restored.step),
+        "drain_before": bool(drain_before),
+        "drain_agreed": bool(drain_agreed),
         "n_devices": jax.device_count(),
         "n_local": jax.local_device_count(),
     }), flush=True)

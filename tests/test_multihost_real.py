@@ -76,6 +76,12 @@ def test_two_process_world_matches_single_process_oracle(devices8, tmp_path):
         assert r["n_local"] == 4, r
         assert r["restored_ok"], "restored params differ from saved"
         assert r["restored_step"] == 4
+        # only host 0 was "signaled"; BOTH hosts must agree to drain
+        # (trainer._drain_agreed's allgather-OR) or a real preemption
+        # would hang mismatched collectives — and with NO host signaled
+        # the helper must say no (falsifies a degenerately-True helper)
+        assert r["drain_before"] is False, r
+        assert r["drain_agreed"] is True, r
 
     # both processes compute the same global step -> identical losses
     np.testing.assert_allclose(

@@ -109,6 +109,34 @@ def test_search_strategy_escalates_on_memory(devices8):
     assert ad.search_report[-1]["fits"] is True
 
 
+def test_search_strategy_moe_ladder(devices8):
+    """MoE models search the expert ladder: the accepted entry is an
+    ep-family strategy and error entries (if any) carry the same schema
+    as measured ones (uniformly indexable report)."""
+    import numpy as np
+    import optax
+
+    import torch_automatic_distributed_neural_network_tpu as tad
+    from torch_automatic_distributed_neural_network_tpu.models import MoE
+    from torch_automatic_distributed_neural_network_tpu.training import (
+        moe_next_token_loss,
+    )
+
+    ad = tad.AutoDistribute(
+        MoE("test", vocab_size=256, max_seq_len=32),
+        optimizer=optax.adamw(1e-4),
+        loss_fn=moe_next_token_loss,
+        strategy="search",
+    )
+    sample = {"tokens": np.zeros((8, 33), np.int32)}
+    plan = ad.build_plan(jax.random.key(0), sample)
+    assert plan.strategy.startswith("ep")
+    for entry in ad.search_report:
+        assert {"strategy", "remat", "peak_bytes", "budget_bytes",
+                "fits", "flops"} <= set(entry)
+    assert ad.search_report[-1]["fits"] is True
+
+
 def test_compile_report_abstract_only(devices8):
     """compile_report AOT-compiles the sharded step without materializing
     any state (the memfit path, bench.py mode=memfit / BASELINE.md row 4):
