@@ -42,6 +42,7 @@ class RunCfg:
     temperature: float = 0.8
     top_k: int = 40
     top_p: float = 1.0  # nucleus sampling; 1.0 = off
+    eos_id: int = -1  # >= 0: rows finalize after emitting this token
     # 'none' -> plain single-program decode; any planner strategy
     # ('tp', 'tp_fsdp', 'fsdp', 'dp') -> plan-aware sharded decode
     # (AutoDistribute.generate: sharded params, KV cache on the mesh)
@@ -66,6 +67,7 @@ def main():
         jnp.int32,
     )
     variables = model.init(jax.random.key(0), prompt)
+    eos = r.eos_id if r.eos_id >= 0 else None
     sample = SampleConfig(temperature=r.temperature, top_k=r.top_k,
                           top_p=r.top_p)
 
@@ -89,10 +91,12 @@ def main():
         print(f"plan: strategy={ad.plan.strategy} "
               f"mesh={tad.mesh_degrees(ad.plan.mesh)}")
         gen = lambda v, p, k: ad.generate(
-            v, p, max_new_tokens=r.new_tokens, sample=sample, rng=k)
+            v, p, max_new_tokens=r.new_tokens, sample=sample, rng=k,
+            eos_id=eos)
     else:
         gen = jax.jit(lambda v, p, k: generate(
-            model, v, p, max_new_tokens=r.new_tokens, sample=sample, rng=k))
+            model, v, p, max_new_tokens=r.new_tokens, sample=sample, rng=k,
+            eos_id=eos))
     # fence with a host readback: on the tunneled TPU, block_until_ready
     # does not synchronize (see bench.py readback_overhead_s)
     t0 = time.perf_counter()

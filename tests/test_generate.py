@@ -88,6 +88,40 @@ def test_sampled_generate_is_deterministic_per_key():
     np.testing.assert_array_equal(np.asarray(a[:, :4]), np.asarray(tokens))
 
 
+def test_eos_finalizes_rows():
+    """After a row emits eos_id, every later position in that row is
+    eos_id, and tokens BEFORE the first eos match the unconstrained
+    run (the eos fill must not perturb live rows)."""
+    from torch_automatic_distributed_neural_network_tpu.inference.decode import (
+        generate,
+    )
+    from torch_automatic_distributed_neural_network_tpu.models import GPT2
+
+    model = GPT2("test", vocab_size=64, max_seq_len=48,
+                 remat_policy="nothing")
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, 64, (2, 6)), jnp.int32
+    )
+    variables = model.init(jax.random.key(1), tokens)
+    free = np.asarray(generate(model, variables, tokens,
+                               max_new_tokens=16))
+    # pick the token the model greedily emits a few steps in as "eos"
+    eos = int(free[0, 6 + 3])
+    out = np.asarray(generate(model, variables, tokens,
+                              max_new_tokens=16, eos_id=eos))
+    for row_free, row in zip(free, out):
+        gen_free, gen = row_free[6:], row[6:]
+        hits = np.nonzero(gen == eos)[0]
+        if len(hits):
+            first = hits[0]
+            # everything after the first eos is eos
+            assert (gen[first:] == eos).all()
+            # everything before it matches the unconstrained run
+            np.testing.assert_array_equal(gen[:first], gen_free[:first])
+        else:
+            np.testing.assert_array_equal(gen, gen_free)
+
+
 def test_top_p_filters_tail():
     """Nucleus sampling: with probs [.5, .3, .15, .05] and top_p=0.7 only
     tokens {0, 1} are in the nucleus (cumulative mass before each is 0

@@ -821,6 +821,7 @@ class AutoDistribute:
         sample=None,
         rng: jax.Array | None = None,
         cache_dtype=jnp.bfloat16,
+        eos_id: int | None = None,
     ):
         """Plan-aware autoregressive generation (inference/decode.py).
 
@@ -844,7 +845,7 @@ class AutoDistribute:
             rng = jax.random.key(0)
         mesh = self.plan.mesh
         key = (max_new_tokens, sample, str(jnp.dtype(cache_dtype)),
-               tuple(getattr(prompt, "shape", ())))
+               eos_id, tuple(getattr(prompt, "shape", ())))
         cached = getattr(self, "_generate_cache", None)
         if cached is None:
             cached = self._generate_cache = {}
@@ -853,7 +854,7 @@ class AutoDistribute:
                 return decode.generate(
                     self.model, {"params": params}, prompt,
                     max_new_tokens=max_new_tokens, sample=sample, rng=rng,
-                    cache_dtype=cache_dtype, mesh=mesh,
+                    cache_dtype=cache_dtype, mesh=mesh, eos_id=eos_id,
                 )
 
             # Small decode batches (e.g. batch 1 on an 8-device mesh)

@@ -255,6 +255,7 @@ def generate(
     rng: jax.Array | None = None,
     cache_dtype=jnp.bfloat16,
     mesh=None,
+    eos_id: int | None = None,
 ) -> jax.Array:
     """Autoregressive generation: prefill + one-token lax.scan decode.
 
@@ -264,6 +265,10 @@ def generate(
     axes, kv heads on tensor — :func:`cache_partition_spec`) so decode
     runs sharded under a plan's mesh (AutoDistribute.generate wraps this
     with the right jit shardings).
+
+    ``eos_id``: once a row samples it, every later position in that row
+    is ``eos_id`` (the output stays fixed-shape — XLA needs static trip
+    counts — but rows are individually final after their EOS).
     """
     cfg: TransformerConfig = model.cfg
     params = variables["params"]
@@ -285,16 +290,25 @@ def generate(
         )
     logits, cache = forward_cached(params, cfg, prompt, cache)
     first = _sample(logits, first_rng, sample)
+    done0 = (
+        first == eos_id if eos_id is not None
+        else jnp.zeros_like(first, bool)
+    )
 
     def body(carry, step_rng):
-        cache, tok = carry
+        cache, tok, done = carry
         logits, cache = forward_cached(params, cfg, tok[:, None], cache)
         nxt = _sample(logits, step_rng, sample)
-        return (cache, nxt), nxt
+        if eos_id is not None:
+            nxt = jnp.where(done, eos_id, nxt)
+            done = jnp.logical_or(done, nxt == eos_id)
+        return (cache, nxt, done), nxt
 
     if max_new_tokens > 1:
-        (_, _), rest = jax.lax.scan(body, (cache, first),
-                                    jax.random.split(rng, max_new_tokens - 1))
+        (_, _, _), rest = jax.lax.scan(
+            body, (cache, first, done0),
+            jax.random.split(rng, max_new_tokens - 1),
+        )
         new_tokens = jnp.concatenate([first[:, None], rest.T], axis=1)
     else:
         new_tokens = first[:, None]
