@@ -417,6 +417,13 @@ def bench_attention(args):
     }
 
 
+# Simulated-device count each CPU-capable mode re-execs onto — ONE place
+# for both the per-mode guards and main()'s backend-down fallback.
+# memfit's entry is a default; it honors a devices= override in main().
+MODE_SIM_DEVICES = {"memfit": 64, "pipeline": 8, "overlap": 8,
+                    "collectives": 8}
+
+
 def _cpu_sim_reexec(n_devices=8, note=""):
     """Re-exec this bench on the 8-device CPU sim when multi-device is
     required but only 1 chip is visible (driver env).  Prints the child's
@@ -755,8 +762,9 @@ def bench_pipeline(args):
     import optax
 
     if jax.device_count() < 4:
-        _cpu_sim_reexec(8, "mode=pipeline: needs >=4 devices; "
-                           "re-running on the 8-device CPU sim")
+        _cpu_sim_reexec(MODE_SIM_DEVICES["pipeline"],
+                        "mode=pipeline: needs >=4 devices; "
+                        "re-running on the CPU sim")
 
     import torch_automatic_distributed_neural_network_tpu as tad
     from torch_automatic_distributed_neural_network_tpu.data.synthetic import (
@@ -841,9 +849,9 @@ def bench_overlap(args):
             LATENCY_HIDING_XLA_FLAGS,
         )
 
-        _cpu_sim_reexec(8, (
-            f"mode=overlap: 1 device visible; re-running on the 8-device "
-            f"CPU sim (on TPU pods set XLA_FLAGS={LATENCY_HIDING_XLA_FLAGS})"
+        _cpu_sim_reexec(MODE_SIM_DEVICES["overlap"], (
+            f"mode=overlap: 1 device visible; re-running on the CPU sim "
+            f"(on TPU pods set XLA_FLAGS={LATENCY_HIDING_XLA_FLAGS})"
         ))
 
     from torch_automatic_distributed_neural_network_tpu.parallel.collectives import (
@@ -874,8 +882,9 @@ def bench_collectives(args):
     import jax
 
     if jax.device_count() < 2:
-        _cpu_sim_reexec(8, "mode=collectives: a collective needs >=2 "
-                           "devices; re-running on the 8-device CPU sim")
+        _cpu_sim_reexec(MODE_SIM_DEVICES["collectives"],
+                        "mode=collectives: a collective needs >=2 "
+                        "devices; re-running on the CPU sim")
 
     from torch_automatic_distributed_neural_network_tpu.parallel.collectives import (
         bench_collective,
@@ -934,8 +943,8 @@ def _probe_backend(timeout_s: int = 300) -> str | None:
 def main():
     args = parse_args()
     err = _probe_backend()
-    cpu_ok = {"memfit": int(args.get("devices", 64)), "pipeline": 8,
-              "overlap": 8, "collectives": 8}
+    cpu_ok = dict(MODE_SIM_DEVICES)
+    cpu_ok["memfit"] = int(args.get("devices", cpu_ok["memfit"]))
     if err is not None and args["mode"] in cpu_ok:
         # These modes run entirely on the CPU sim anyway; a dead TPU
         # tunnel must not block them — re-exec straight onto the device

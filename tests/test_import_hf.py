@@ -139,6 +139,79 @@ def test_mixtral_logits_match_transformers():
     )
 
 
+def test_gpt2_export_roundtrip_loads_into_transformers():
+    """export_hf_gpt2 is the exact inverse of import: the exported
+    state_dict loads into a fresh transformers model (strict=True after
+    tensor conversion) and reproduces the original logits."""
+    cfg = transformers.GPT2Config(
+        vocab_size=128, n_positions=32, n_embd=64, n_layer=2, n_head=1,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+    )
+    torch.manual_seed(9)
+    hf = transformers.GPT2LMHeadModel(cfg).eval()
+    from torch_automatic_distributed_neural_network_tpu.models import (
+        export_hf_gpt2,
+    )
+
+    # randomize biases so a dropped bias key would change logits (fresh
+    # HF models zero-init them, which would mask an incomplete export)
+    with torch.no_grad():
+        for name, t in hf.named_parameters():
+            if name.endswith("bias"):
+                t.add_(torch.randn_like(t) * 0.1)
+    model, variables = import_hf_gpt2(hf, dtype=jnp.float32)
+    sd = {k: torch.tensor(v) for k, v in
+          export_hf_gpt2(model, variables).items()}
+    hf2 = transformers.GPT2LMHeadModel(cfg)
+    # HF registers causal-mask buffers not in our export; load
+    # non-strict but assert ONLY those are missing, nothing rejected
+    missing, unexpected = hf2.load_state_dict(sd, strict=False)
+    assert not unexpected, unexpected
+    assert all(
+        m.endswith(".attn.bias") or m.endswith(".attn.masked_bias")
+        for m in missing
+    ), missing
+    hf2.eval()
+    tokens = torch.tensor(
+        np.random.RandomState(10).randint(0, 128, (2, 9)))
+    with torch.no_grad():
+        np.testing.assert_allclose(
+            hf2(tokens).logits.numpy(), hf(tokens).logits.numpy(),
+            rtol=1e-5, atol=1e-5,
+        )
+
+
+def test_llama_export_roundtrip_loads_into_transformers():
+    cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=32,
+        rms_norm_eps=1e-5, tie_word_embeddings=False,
+    )
+    torch.manual_seed(11)
+    hf = transformers.LlamaForCausalLM(cfg).eval()
+    from torch_automatic_distributed_neural_network_tpu.models import (
+        export_hf_llama,
+    )
+
+    model, variables = import_hf_llama(hf, max_seq_len=32,
+                                       dtype=jnp.float32)
+    sd = {k: torch.tensor(v) for k, v in
+          export_hf_llama(model, variables).items()}
+    hf2 = transformers.LlamaForCausalLM(cfg)
+    missing, unexpected = hf2.load_state_dict(sd, strict=False)
+    assert not unexpected, unexpected
+    assert not missing, missing
+    hf2.eval()
+    tokens = torch.tensor(
+        np.random.RandomState(12).randint(0, 128, (2, 7)))
+    with torch.no_grad():
+        np.testing.assert_allclose(
+            hf2(tokens).logits.numpy(), hf(tokens).logits.numpy(),
+            rtol=1e-5, atol=1e-5,
+        )
+
+
 def test_imported_model_trains_distributed(devices8):
     """The imported tree drops straight into AutoDistribute: shard it
     over the 8-device mesh and take optimizer steps."""

@@ -1,7 +1,13 @@
 """Model zoo mirroring the reference's example models (SURVEY.md C11/C12)."""
 
 from .gpt2 import GPT2, gpt2_config
-from .import_hf import import_hf_gpt2, import_hf_llama, import_hf_mixtral
+from .import_hf import (
+    export_hf_gpt2,
+    export_hf_llama,
+    import_hf_gpt2,
+    import_hf_llama,
+    import_hf_mixtral,
+)
 from .llama import Llama, llama_config
 from .mlp import MLP
 from .moe import MoE, MoEConfig, MoELM, moe_config
@@ -16,6 +22,8 @@ __all__ = [
     "import_hf_gpt2",
     "import_hf_llama",
     "import_hf_mixtral",
+    "export_hf_gpt2",
+    "export_hf_llama",
     "Llama",
     "llama_config",
     "MoE",

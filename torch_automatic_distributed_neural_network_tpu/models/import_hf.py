@@ -307,6 +307,126 @@ def import_hf_llama(
     return DecoderLM(cfg), c.assemble(layers)
 
 
+def export_hf_gpt2(model, variables) -> dict:
+    """Our GPT2 -> an HF ``GPT2LMHeadModel`` state_dict (numpy values;
+    ``torch.tensor`` them or pass through ``model.load_state_dict`` after
+    conversion).  Inverse of :func:`import_hf_gpt2`; the round-trip is
+    pinned by tests/test_import_hf.py."""
+    import jax
+
+    cfg = model.cfg
+    p = variables["params"] if "params" in variables else variables
+    d = cfg.d_model
+    sd: dict[str, np.ndarray] = {
+        "transformer.wte.weight": _np(p["embed"]["embedding"]),
+        "transformer.wpe.weight": _np(p["pos_embed"]),
+        "transformer.ln_f.weight": _np(p["final_norm"]["scale"]),
+        "transformer.ln_f.bias": _np(p["final_norm"]["bias"]),
+    }
+    if cfg.tie_embeddings:
+        sd["lm_head.weight"] = sd["transformer.wte.weight"]
+    else:
+        sd["lm_head.weight"] = np.ascontiguousarray(
+            _np(p["lm_head"]["kernel"]).T
+        )
+    # one host transfer for the whole stacked [L, ...] tree, not per layer
+    L = jax.tree.map(_np, p["layers"])
+    for i in range(cfg.n_layers):
+        def leaf(*path):
+            node = L
+            for k in path:
+                node = node[k]
+            return node[i]
+
+        pre = f"transformer.h.{i}."
+        qkv_w = np.concatenate(
+            [leaf("attn", f"{n}_proj", "kernel").reshape(d, d)
+             for n in ("q", "k", "v")], axis=1,
+        )
+        qkv_b = np.concatenate(
+            [leaf("attn", f"{n}_proj", "bias").reshape(d)
+             for n in ("q", "k", "v")], axis=0,
+        )
+        sd.update({
+            pre + "ln_1.weight": leaf("attn_norm", "scale"),
+            pre + "ln_1.bias": leaf("attn_norm", "bias"),
+            pre + "attn.c_attn.weight": qkv_w,  # Conv1D [in, out]
+            pre + "attn.c_attn.bias": qkv_b,
+            pre + "attn.c_proj.weight": leaf(
+                "attn", "o_proj", "kernel"
+            ).reshape(d, d),
+            pre + "attn.c_proj.bias": leaf("attn", "o_proj", "bias"),
+            pre + "ln_2.weight": leaf("mlp_norm", "scale"),
+            pre + "ln_2.bias": leaf("mlp_norm", "bias"),
+            pre + "mlp.c_fc.weight": leaf("mlp", "up_proj", "kernel"),
+            pre + "mlp.c_fc.bias": leaf("mlp", "up_proj", "bias"),
+            pre + "mlp.c_proj.weight": leaf("mlp", "down_proj", "kernel"),
+            pre + "mlp.c_proj.bias": leaf("mlp", "down_proj", "bias"),
+        })
+    return sd
+
+
+def export_hf_llama(model, variables) -> dict:
+    """Our Llama -> an HF ``LlamaForCausalLM`` state_dict (numpy values).
+    Inverse of :func:`import_hf_llama`; round-trip pinned by tests."""
+    import jax
+
+    cfg = model.cfg
+    p = variables["params"] if "params" in variables else variables
+    d = cfg.d_model
+    sd: dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": _np(p["embed"]["embedding"]),
+        "model.norm.weight": _np(p["final_norm"]["scale"]),
+    }
+    if cfg.tie_embeddings:
+        sd["lm_head.weight"] = sd["model.embed_tokens.weight"]
+    else:
+        sd["lm_head.weight"] = np.ascontiguousarray(
+            _np(p["lm_head"]["kernel"]).T
+        )
+    # one host transfer for the whole stacked [L, ...] tree, not per layer
+    L = jax.tree.map(_np, p["layers"])
+    for i in range(cfg.n_layers):
+        def leaf(*path):
+            node = L
+            for k in path:
+                node = node[k]
+            return node[i]
+
+        pre = f"model.layers.{i}."
+
+        def t(kernel, in_dim=d):
+            # our [in, *out] -> torch Linear [out, in]
+            return np.ascontiguousarray(
+                kernel.reshape(in_dim, -1).T
+            )
+
+        sd.update({
+            pre + "input_layernorm.weight": leaf("attn_norm", "scale"),
+            pre + "self_attn.q_proj.weight": t(
+                leaf("attn", "q_proj", "kernel")),
+            pre + "self_attn.k_proj.weight": t(
+                leaf("attn", "k_proj", "kernel")),
+            pre + "self_attn.v_proj.weight": t(
+                leaf("attn", "v_proj", "kernel")),
+            # ours [H, hd, d] -> [d, H*hd] -> torch [d(out), H*hd(in)]
+            pre + "self_attn.o_proj.weight": np.ascontiguousarray(
+                leaf("attn", "o_proj", "kernel").reshape(-1, d).T
+            ),
+            pre + "post_attention_layernorm.weight": leaf(
+                "mlp_norm", "scale"),
+            pre + "mlp.gate_proj.weight": t(
+                leaf("mlp", "gate_proj", "kernel")),
+            pre + "mlp.up_proj.weight": t(
+                leaf("mlp", "up_proj", "kernel")),
+            pre + "mlp.down_proj.weight": t(
+                leaf("mlp", "down_proj", "kernel"),
+                in_dim=leaf("mlp", "down_proj", "kernel").shape[0],
+            ),
+        })
+    return sd
+
+
 def import_hf_mixtral(
     model_or_state_dict, *, max_seq_len: int | None = None,
     rope_theta: float | None = None,
