@@ -42,7 +42,7 @@ class ModelCfg:
 @dataclasses.dataclass(frozen=True)
 class RunCfg:
     steps: int = 20
-    batch_size: int = 4
+    batch_size: int = 8  # divides the 8-device CPU sim and any 2^k slice
     lr: float = 3e-4
     log_every: int = 5
     metrics_path: str = ""
