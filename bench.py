@@ -749,9 +749,12 @@ def bench_memfit(args):
 
 
 def bench_pipeline(args):
-    """Microbatch sweep comparing the 'dense' (round-2 GPipe, bubble
-    iterations compute on garbage) and 'cond' (bubbles skip compute via
-    per-device lax.cond) schedules at M=2/4/8 on pipe=2 and pipe=4.
+    """Microbatch sweep comparing all three schedules at M=2/4/8 on
+    pipe=2 and pipe=4: 'dense' (round-2 GPipe, bubble iterations compute
+    on garbage), 'cond' (bubbles skip compute via per-device lax.cond),
+    and '1f1b' (hand-scheduled backward, 2S-1 stash ring — pays one
+    extra forward wavefront but ALSO skips backward-tick bubbles, which
+    AD-GPipe cannot).
 
     On the CPU sim the devices share host cores, so skipped bubble FLOPs
     translate directly into wall-clock — an upper bound on the real-chip
@@ -779,7 +782,7 @@ def bench_pipeline(args):
     )
 
     seq, vocab = 128, 512
-    steps = min(int(args["steps"]), 10)  # 12 configs; compiles dominate
+    steps = min(int(args["steps"]), 10)  # 18 compiled configs dominate
     rows = []
     for stages in (2, 4):
         for M in (2, 4, 8):
@@ -789,7 +792,7 @@ def bench_pipeline(args):
             data = SyntheticLM(vocab_size=vocab, seq_len=seq + 1,
                                batch_size=batch)
             times = {}
-            for sched in ("dense", "cond"):
+            for sched in ("dense", "cond", "1f1b"):
                 ad = tad.AutoDistribute(
                     GPT2("test", vocab_size=vocab, max_seq_len=seq,
                          n_layers=8),
@@ -809,13 +812,19 @@ def bench_pipeline(args):
                 "stages": stages, "microbatches": M,
                 "dense_ms": round(times["dense"] * 1e3, 1),
                 "cond_ms": round(times["cond"] * 1e3, 1),
+                # 1f1b trades one extra forward wavefront for the
+                # M-independent memory bound; this column records the
+                # cost side of that trade honestly
+                "onef_oneb_ms": round(times["1f1b"] * 1e3, 1),
                 "speedup": round(times["dense"] / times["cond"], 3),
+                "onef_vs_cond": round(times["1f1b"] / times["cond"], 3),
                 "bubble_frac": round(bubble_fraction(stages, M), 3),
             }
             rows.append(row)
             log(f"pipe={stages} M={M}: dense {row['dense_ms']}ms "
-                f"cond {row['cond_ms']}ms -> {row['speedup']}x "
-                f"(bubble {row['bubble_frac']:.0%})")
+                f"cond {row['cond_ms']}ms 1f1b {row['onef_oneb_ms']}ms "
+                f"-> cond {row['speedup']}x, 1f1b/cond "
+                f"{row['onef_vs_cond']}x (bubble {row['bubble_frac']:.0%})")
 
     worst = max(rows, key=lambda r: r["speedup"])
     return {
