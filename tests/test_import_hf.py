@@ -212,6 +212,38 @@ def test_llama_export_roundtrip_loads_into_transformers():
         )
 
 
+def test_mixtral_export_roundtrip_loads_into_transformers():
+    cfg = transformers.MixtralConfig(
+        vocab_size=96, hidden_size=64, intermediate_size=80,
+        num_hidden_layers=2, num_attention_heads=2,
+        num_key_value_heads=1, num_local_experts=4,
+        num_experts_per_tok=2, max_position_embeddings=32,
+        rms_norm_eps=1e-5, tie_word_embeddings=False,
+    )
+    torch.manual_seed(13)
+    hf = transformers.MixtralForCausalLM(cfg).eval()
+    from torch_automatic_distributed_neural_network_tpu.models import (
+        export_hf_mixtral,
+        import_hf_mixtral,
+    )
+
+    model, variables = import_hf_mixtral(hf, dtype=jnp.float32)
+    sd = {k: torch.tensor(v) for k, v in
+          export_hf_mixtral(model, variables).items()}
+    hf2 = transformers.MixtralForCausalLM(cfg)
+    missing, unexpected = hf2.load_state_dict(sd, strict=False)
+    assert not unexpected, unexpected
+    assert not missing, missing
+    hf2.eval()
+    tokens = torch.tensor(
+        np.random.RandomState(14).randint(0, 96, (2, 8)))
+    with torch.no_grad():
+        np.testing.assert_allclose(
+            hf2(tokens).logits.numpy(), hf(tokens).logits.numpy(),
+            rtol=1e-5, atol=1e-5,
+        )
+
+
 def test_imported_model_trains_distributed(devices8):
     """The imported tree drops straight into AutoDistribute: shard it
     over the 8-device mesh and take optimizer steps."""

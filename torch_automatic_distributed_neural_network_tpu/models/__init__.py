@@ -4,6 +4,7 @@ from .gpt2 import GPT2, gpt2_config
 from .import_hf import (
     export_hf_gpt2,
     export_hf_llama,
+    export_hf_mixtral,
     import_hf_gpt2,
     import_hf_llama,
     import_hf_mixtral,
@@ -24,6 +25,7 @@ __all__ = [
     "import_hf_mixtral",
     "export_hf_gpt2",
     "export_hf_llama",
+    "export_hf_mixtral",
     "Llama",
     "llama_config",
     "MoE",

@@ -366,13 +366,12 @@ def export_hf_gpt2(model, variables) -> dict:
     return sd
 
 
-def export_hf_llama(model, variables) -> dict:
-    """Our Llama -> an HF ``LlamaForCausalLM`` state_dict (numpy values).
-    Inverse of :func:`import_hf_llama`; round-trip pinned by tests."""
+def _export_llama_family(cfg, p, mlp_block) -> dict:
+    """Shared Llama-family export skeleton (inverse of _LlamaCommon):
+    embed/final-norm/lm-head header + per-layer attention/norm mapping;
+    ``mlp_block(leaf, t, pre, sd)`` fills in the family's MLP keys."""
     import jax
 
-    cfg = model.cfg
-    p = variables["params"] if "params" in variables else variables
     d = cfg.d_model
     sd: dict[str, np.ndarray] = {
         "model.embed_tokens.weight": _np(p["embed"]["embedding"]),
@@ -415,6 +414,19 @@ def export_hf_llama(model, variables) -> dict:
             ),
             pre + "post_attention_layernorm.weight": leaf(
                 "mlp_norm", "scale"),
+        })
+        mlp_block(leaf, t, pre, sd)
+    return sd
+
+
+def export_hf_llama(model, variables) -> dict:
+    """Our Llama -> an HF ``LlamaForCausalLM`` state_dict (numpy values).
+    Inverse of :func:`import_hf_llama`; round-trip pinned by tests."""
+    cfg = model.cfg
+    p = variables["params"] if "params" in variables else variables
+
+    def mlp_block(leaf, t, pre, sd):
+        sd.update({
             pre + "mlp.gate_proj.weight": t(
                 leaf("mlp", "gate_proj", "kernel")),
             pre + "mlp.up_proj.weight": t(
@@ -424,7 +436,39 @@ def export_hf_llama(model, variables) -> dict:
                 in_dim=leaf("mlp", "down_proj", "kernel").shape[0],
             ),
         })
-    return sd
+
+    return _export_llama_family(cfg, p, mlp_block)
+
+
+def export_hf_mixtral(model, variables) -> dict:
+    """Our MoELM -> an HF ``MixtralForCausalLM`` state_dict (numpy
+    values).  Inverse of :func:`import_hf_mixtral`; round-trip pinned by
+    tests.  Only swiglu MoE models map onto Mixtral's w1/w3/w2 expert
+    layout (import always builds swiglu; natively-built gelu MoELMs have
+    no experts_gate bank)."""
+    cfg = model.cfg
+    if cfg.act != "swiglu":
+        raise ValueError(
+            f"export_hf_mixtral needs act='swiglu' (Mixtral's w1/w3/w2 "
+            f"layout); this model has act={cfg.act!r} and no "
+            f"experts_gate bank"
+        )
+    p = variables["params"] if "params" in variables else variables
+
+    def mlp_block(leaf, t, pre, sd):
+        sd[pre + "block_sparse_moe.gate.weight"] = t(
+            leaf("mlp", "router", "kernel")
+        )
+        gate = leaf("mlp", "experts_gate")  # [E, d, ff]
+        up = leaf("mlp", "experts_up")
+        down = leaf("mlp", "experts_down")  # [E, ff, d]
+        for e in range(cfg.n_experts):
+            epre = pre + f"block_sparse_moe.experts.{e}."
+            sd[epre + "w1.weight"] = np.ascontiguousarray(gate[e].T)
+            sd[epre + "w3.weight"] = np.ascontiguousarray(up[e].T)
+            sd[epre + "w2.weight"] = np.ascontiguousarray(down[e].T)
+
+    return _export_llama_family(cfg, p, mlp_block)
 
 
 def import_hf_mixtral(
