@@ -922,31 +922,12 @@ def bench_collectives(args):
 
 
 def _probe_backend(timeout_s: int = 300) -> str | None:
-    """Initialize the JAX backend in a SUBPROCESS with a timeout.
-
-    The tunneled axon TPU backend can hang ``jax.devices()`` indefinitely
-    when the tunnel is down (observed 2026-07-29: 24-minute hang, then
-    'UNAVAILABLE: TPU backend setup/compile error') — and the hang is in
-    a C call, so no in-process alarm can break it.  Returns an error
-    string when the backend is unreachable, None when it is fine.
-    """
+    """Subprocess-with-timeout backend probe (shared: see tpu_probe.py)."""
     import os
-    import subprocess
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tpu_probe import probe_backend
 
-    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-        return None  # CPU sim never hangs
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].device_kind)"],
-            capture_output=True, text=True, timeout=timeout_s,
-        )
-    except subprocess.TimeoutExpired:
-        return f"backend init hung > {timeout_s}s (tunnel down?)"
-    if proc.returncode != 0:
-        return proc.stderr.strip().splitlines()[-1][:300] if (
-            proc.stderr.strip()) else f"backend init rc={proc.returncode}"
-    return None
+    return probe_backend(timeout_s)
 
 
 def main():

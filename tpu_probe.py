@@ -1,0 +1,74 @@
+"""Stdlib-only driver-path helpers: backend probe + CPU-sim child env.
+
+The tunneled axon TPU backend can hang ``jax.devices()`` indefinitely when
+the tunnel is down (observed 2026-07-29: 24-minute hang, then
+'UNAVAILABLE: TPU backend setup/compile error') — and the hang is inside a
+C call, so no in-process alarm/signal can break it.  The only safe probe
+is a SUBPROCESS with a timeout.  This module is shared by ``bench.py``,
+``__graft_entry__.py`` and ``utils/simenv.py`` and must stay stdlib-only:
+it runs on the driver's parent path where importing jax (and thereby
+risking backend init) is exactly the hang vector being guarded against.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def probe_backend(timeout_s: int = 300) -> str | None:
+    """Initialize the JAX backend in a subprocess with a timeout.
+
+    Returns an error string when the backend is unreachable, None when it
+    is fine (or when the process is already forced onto the CPU platform,
+    which never hangs).
+    """
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return None
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].device_kind)"],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return f"backend init hung > {timeout_s}s (tunnel down?)"
+    if proc.returncode != 0:
+        return proc.stderr.strip().splitlines()[-1][:300] if (
+            proc.stderr.strip()) else f"backend init rc={proc.returncode}"
+    return None
+
+
+def cpu_sim_env(
+    n_devices: int,
+    base: dict | None = None,
+    *,
+    extra_pythonpath: tuple[str, ...] = (),
+) -> dict:
+    """Environment for a child process on ``n_devices`` simulated CPU
+    devices: drop the axon sitecustomize from PYTHONPATH (it forces the
+    TPU platform at interpreter start), force JAX_PLATFORMS=cpu, and set
+    the virtual device count in XLA_FLAGS (replacing any existing count
+    flag).  ``extra_pythonpath`` entries are prepended (e.g. the repo
+    root for test workers)."""
+    env = dict(os.environ if base is None else base)
+    paths = [
+        p for p in (
+            *extra_pythonpath,
+            *env.get("PYTHONPATH", "").split(os.pathsep),
+        ) if p and "axon" not in p
+    ]
+    if paths:
+        env["PYTHONPATH"] = os.pathsep.join(paths)
+    else:
+        env.pop("PYTHONPATH", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    env["XLA_FLAGS"] = " ".join(
+        flags + [f"--xla_force_host_platform_device_count={n_devices}"]
+    )
+    return env
