@@ -4,12 +4,10 @@
 # The axon tunnel goes down for hours at a time; TPU windows are short.
 # This loop probes the backend every POLL seconds and, the moment it is
 # reachable, drains the job queue (.tpu_capture/queue.txt — one shell
-# command per line, '#' comments allowed).  Each job's stdout+stderr is
-# logged to .tpu_capture/logs/<n>.log; completed jobs are appended to
-# done.txt and removed from the queue, so jobs can be appended while the
-# loop runs.  The loop never exits on its own: after draining it keeps
-# polling for new jobs (cheap probe only happens when the queue is
-# non-empty).
+# command per line, '#' comments allowed).  Jobs are POPPED from the
+# queue (under flock, so concurrent appends are never lost) BEFORE they
+# run; each job's stdout+stderr lands in .tpu_capture/logs/, and
+# completions append to done.txt.  The loop never exits on its own.
 cd /root/repo
 DIR=.tpu_capture
 POLL=240
@@ -17,11 +15,14 @@ mkdir -p "$DIR/logs"
 touch "$DIR/queue.txt" "$DIR/done.txt"
 n=0
 while true; do
-  # next pending job = first non-comment non-blank line
   job=$(grep -v '^\s*#' "$DIR/queue.txt" | grep -v '^\s*$' | head -1)
   if [ -z "$job" ]; then sleep 30; continue; fi
   echo "[watch $(date +%H:%M:%S)] probing (pending: $job)"
   if timeout 90 python -c "import jax; print(jax.devices()[0].device_kind)" >/dev/null 2>&1; then
+    # pop-before-run, atomically w.r.t. concurrent appends
+    flock "$DIR/queue.txt" bash -c '
+      grep -vxF "$1" "$0" > "$0.tmp" && mv "$0.tmp" "$0"
+    ' "$DIR/queue.txt" "$job"
     n=$((n+1))
     log="$DIR/logs/$(date +%m%d-%H%M%S)-$n.log"
     echo "[watch $(date +%H:%M:%S)] TPU UP — running: $job -> $log"
@@ -29,18 +30,6 @@ while true; do
     rc=$?
     echo "[watch $(date +%H:%M:%S)] rc=$rc: $job"
     echo "rc=$rc | $(date +%m%d-%H%M%S) | $log | $job" >> "$DIR/done.txt"
-    # pop the job line (first exact match) from the queue
-    python - "$job" <<'EOF'
-import sys
-job = sys.argv[1]
-path = ".tpu_capture/queue.txt"
-lines = open(path).readlines()
-for i, l in enumerate(lines):
-    if l.strip() == job.strip():
-        del lines[i]
-        break
-open(path, "w").writelines(lines)
-EOF
   else
     echo "[watch $(date +%H:%M:%S)] tunnel down; sleeping $POLL"
     sleep $POLL

@@ -696,17 +696,30 @@ def bench_memfit(args):
     batch = int(args.get("memfit_batch", n))
     cp = int(args.get("memfit_cp", 1))  # context-parallel degree
     hbm_gib = float(args.get("hbm_gib", 88.5))  # v5p: 95 GB = ~88.5 GiB
+    # loss=blockwise folds the LM head into a seq-blockwise CE so the
+    # fp32 [B,S,128k] logits pair (16.3 of r3's 17.2 GiB peak) never
+    # materializes; loss=full is the plain next_token_loss baseline
+    loss_kind = str(args.get("memfit_loss", "blockwise"))
+    ce_block = int(args.get("memfit_ce_block", 512))
     mcfg = llama_config(size, max_seq_len=seq)
     log(f"memfit: Llama {size} ({mcfg.num_params()/1e9:.2f}B params) "
         f"seq={seq} batch={batch} fsdp={n // cp}"
         + (f" x cp={cp}" if cp > 1 else "")
-        + " (abstract AOT compile)")
+        + f" loss={loss_kind} (abstract AOT compile)")
+    if loss_kind == "blockwise":
+        from torch_automatic_distributed_neural_network_tpu.training import (
+            blockwise_next_token_loss,
+        )
+
+        loss_fn = blockwise_next_token_loss(ce_block)
+    else:
+        loss_fn = next_token_loss
     ad = tad.AutoDistribute(
         # per-layer full recompute (the 1.3B bench recipe) + mixed
         # precision: bf16 compute/grads/moments, fp32 master params
         Llama(size, max_seq_len=seq, remat_policy="nothing"),
         optimizer=optax.adamw(3e-4),
-        loss_fn=next_token_loss,
+        loss_fn=loss_fn,
         strategy="fsdp",
         precision="mixed",
         remat=False,
@@ -727,7 +740,8 @@ def bench_memfit(args):
     log(f"compiled in {dt:.0f}s: per-device peak {peak_gib:.2f} GiB "
         f"(state {mem.get('argument_size', 0)/2**30:.2f} GiB + temps "
         f"{mem.get('temp_size', 0)/2**30:.2f} GiB) vs {hbm_gib} GiB HBM")
-    label = f"fsdp{n // cp}" + (f"_cp{cp}" if cp > 1 else "")
+    label = f"fsdp{n // cp}" + (f"_cp{cp}" if cp > 1 else "") + (
+        "_blockwise_ce" if loss_kind == "blockwise" else "")
     return {
         "metric": f"llama{size}_{label}_per_device_peak",
         "value": round(peak_gib, 3),
@@ -739,6 +753,8 @@ def bench_memfit(args):
             "params_b": round(mcfg.num_params() / 1e9, 3),
             "seq": seq, "batch": batch, "n_devices": n,
             "precision": "mixed", "remat_policy": "nothing",
+            "loss": loss_kind,
+            **({"ce_block": ce_block} if loss_kind == "blockwise" else {}),
             "compile_s": round(dt, 1),
             "hbm_budget_gib": hbm_gib,
             "note": ("abstract-shapes AOT compile on a CPU-sim mesh; "
@@ -792,7 +808,12 @@ def bench_pipeline(args):
             data = SyntheticLM(vocab_size=vocab, seq_len=seq + 1,
                                batch_size=batch)
             times = {}
-            for sched in ("dense", "cond", "1f1b"):
+            # interleaved needs M % S == 0 and benefits exactly when the
+            # bubble matters (small M); V=2 over the 8-layer stack
+            scheds = ["dense", "cond", "1f1b"]
+            if M % stages == 0:
+                scheds.append("interleaved")
+            for sched in scheds:
                 ad = tad.AutoDistribute(
                     GPT2("test", vocab_size=vocab, max_seq_len=seq,
                          n_layers=8),
@@ -802,6 +823,7 @@ def bench_pipeline(args):
                     pipeline_stages=stages,
                     microbatches=M,
                     pipeline_schedule=sched,
+                    pipeline_virtual=2 if sched == "interleaved" else 1,
                 )
                 state = ad.step(ad.init(jax.random.key(0), data.batch(0)),
                                 data.batch(0))[0]  # compile+warm
@@ -819,11 +841,20 @@ def bench_pipeline(args):
                 "speedup": round(times["dense"] / times["cond"], 3),
                 "onef_vs_cond": round(times["1f1b"] / times["cond"], 3),
                 "bubble_frac": round(bubble_fraction(stages, M), 3),
+                **({
+                    "interleaved_ms": round(times["interleaved"] * 1e3, 1),
+                    "interleaved_vs_cond": round(
+                        times["interleaved"] / times["cond"], 3),
+                    "bubble_frac_v2": round(
+                        (stages - 1) / (M * 2 + stages - 1), 3),
+                } if "interleaved" in times else {}),
             }
             rows.append(row)
             log(f"pipe={stages} M={M}: dense {row['dense_ms']}ms "
-                f"cond {row['cond_ms']}ms 1f1b {row['onef_oneb_ms']}ms "
-                f"-> cond {row['speedup']}x, 1f1b/cond "
+                f"cond {row['cond_ms']}ms 1f1b {row['onef_oneb_ms']}ms"
+                + (f" interleavedV2 {row['interleaved_ms']}ms"
+                   if "interleaved_ms" in row else "")
+                + f" -> cond {row['speedup']}x, 1f1b/cond "
                 f"{row['onef_vs_cond']}x (bubble {row['bubble_frac']:.0%})")
 
     worst = max(rows, key=lambda r: r["speedup"])

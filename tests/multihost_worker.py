@@ -100,13 +100,14 @@ def main():
         PreemptionGuard,
     )
 
-    trainer = Trainer(ad, TrainerConfig(steps=1, preempt_drain=False))
+    trainer = Trainer(ad, TrainerConfig(steps=1, preempt_drain=False,
+                                    preempt_check_every=1))
     trainer.preempt = PreemptionGuard()  # not installed; flag-only
     # no host signaled -> no drain (falsifies a degenerately-True helper)
-    drain_before = trainer._drain_agreed()
+    drain_before = trainer._drain_agreed(1)
     if pid == 0:
         trainer.preempt.request()
-    drain_agreed = trainer._drain_agreed()
+    drain_agreed = trainer._drain_agreed(1)
 
     print(json.dumps({
         "process": pid,

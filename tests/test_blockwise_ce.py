@@ -1,0 +1,159 @@
+"""Blockwise / vocab-sharded cross-entropy (VERDICT r3 #5).
+
+Parity is pinned against the materializing ``next_token_loss`` on the
+same params: loss values and grads must agree for tied and untied heads,
+with and without padding masks, and for MoE (aux-loss path).  The
+sharded test runs the loss under a tp mesh where lm_head is
+vocab-sharded (planner rule ``lm_head/kernel -> (None, 'tensor')``) and
+checks 1-dev parity.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torch_automatic_distributed_neural_network_tpu.models import (  # noqa: E402
+    DecoderLM,
+    MoE,
+)
+from torch_automatic_distributed_neural_network_tpu.models.transformer_core import (  # noqa: E402
+    TransformerConfig,
+)
+from torch_automatic_distributed_neural_network_tpu.training import (  # noqa: E402
+    blockwise_next_token_loss,
+    moe_next_token_loss,
+    next_token_loss,
+)
+
+
+def _apply_fn(model):
+    return lambda p, *a, **k: model.apply({"params": p}, *a, **k)
+
+
+def _setup(tied, vocab=97, seq=33):
+    cfg = TransformerConfig(
+        vocab_size=vocab, d_model=64, n_layers=2, n_heads=4,
+        max_seq_len=seq + 8, tie_embeddings=tied,
+    )
+    model = DecoderLM(cfg)
+    toks = np.random.RandomState(0).randint(0, vocab, (3, seq))
+    batch = {"tokens": jnp.asarray(toks)}
+    params = model.init(jax.random.key(0), batch["tokens"][:, :-1])["params"]
+    return model, params, batch
+
+
+@pytest.mark.parametrize("tied", [True, False])
+@pytest.mark.parametrize("block", [8, 16, 64])  # 64 > S: single block
+def test_loss_and_grad_parity(tied, block):
+    model, params, batch = _setup(tied)
+    fn = _apply_fn(model)
+    ref, _ = next_token_loss(params, batch, None, fn)
+    got, _ = blockwise_next_token_loss(block)(params, batch, None, fn)
+    np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
+
+    g_ref = jax.grad(lambda p: next_token_loss(p, batch, None, fn)[0])(params)
+    g_got = jax.grad(
+        lambda p: blockwise_next_token_loss(block)(p, batch, None, fn)[0]
+    )(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-3),
+        g_ref, g_got)
+
+
+def test_masked_parity():
+    model, params, batch = _setup(tied=False)
+    fn = _apply_fn(model)
+    mask = np.ones_like(np.asarray(batch["tokens"]), np.float32)
+    mask[:, 20:] = 0.0  # padding tail
+    mask[1, 5:] = 0.0
+    batch = dict(batch, mask=jnp.asarray(mask))
+    ref, _ = next_token_loss(params, batch, None, fn)
+    got, _ = blockwise_next_token_loss(8)(params, batch, None, fn)
+    np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
+
+
+def test_moe_aux_loss_parity():
+    model = MoE("test", vocab_size=64, max_seq_len=40)
+    toks = np.random.RandomState(1).randint(0, 64, (4, 33))
+    batch = {"tokens": jnp.asarray(toks)}
+    params = model.init(jax.random.key(0), batch["tokens"][:, :-1])["params"]
+    fn = _apply_fn(model)
+    ref, ref_aux = moe_next_token_loss(params, batch, None, fn)
+    got, got_aux = blockwise_next_token_loss(8)(params, batch, None, fn)
+    np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
+    np.testing.assert_allclose(float(got_aux["router_loss"]),
+                               float(ref_aux["router_loss"]), rtol=1e-5)
+
+
+def test_autodistribute_tp_vocab_sharded(devices8):
+    """Full AutoDistribute tp_fsdp step with the blockwise loss: lm_head
+    is vocab-sharded over 'tensor', and the 8-device trajectory matches
+    the 1-device oracle."""
+    import optax
+
+    import torch_automatic_distributed_neural_network_tpu as tad
+
+    def make(devices, strategy):
+        cfg = TransformerConfig(
+            vocab_size=128, d_model=64, n_layers=2, n_heads=4,
+            max_seq_len=48, tie_embeddings=False,
+        )
+        return tad.AutoDistribute(
+            DecoderLM(cfg),
+            optimizer=optax.sgd(0.1),
+            loss_fn=blockwise_next_token_loss(16),
+            strategy=strategy,
+            devices=devices,
+        )
+
+    toks = np.random.RandomState(2).randint(0, 128, (8, 41))
+    batch = {"tokens": jnp.asarray(toks)}
+
+    losses = {}
+    for name, devs, strat in (
+        ("1dev", jax.devices()[:1], "dp"),
+        ("8dev", jax.devices(), "tp_fsdp"),
+    ):
+        ad = make(devs, strat)
+        state = ad.init(jax.random.key(0), batch)
+        run = []
+        for _ in range(3):
+            state, metrics = ad.step(state, batch)
+            run.append(float(metrics["loss"]))
+        losses[name] = run
+    np.testing.assert_allclose(losses["8dev"], losses["1dev"],
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_peak_temp_smaller_than_full_loss(devices8):
+    """The point of the exercise: AOT memory analysis shows materially
+    smaller temps than the materializing loss on a long-seq, big-vocab
+    config (per-device, fsdp over 8 sim devices)."""
+    import optax
+
+    import torch_automatic_distributed_neural_network_tpu as tad
+
+    def peak(loss_fn):
+        cfg = TransformerConfig(
+            vocab_size=32768, d_model=128, n_layers=2, n_heads=4,
+            max_seq_len=1024, tie_embeddings=False, scan_layers=True,
+        )
+        ad = tad.AutoDistribute(
+            DecoderLM(cfg),
+            optimizer=optax.adamw(1e-3),
+            loss_fn=loss_fn,
+            strategy="fsdp",
+            devices=jax.devices(),
+        )
+        sample = {"tokens": np.zeros((8, 1025), np.int32)}
+        report = ad.compile_report(jax.random.key(0), sample)
+        assert report and report.get("per_device_peak_bytes")
+        return report["memory"]["temp_size"]
+
+    full = peak(next_token_loss)
+    blockwise = peak(blockwise_next_token_loss(128))
+    # full loss holds the fp32 [8,1024,32768] logits + its grad twin
+    # (~2 GiB over 8 devices); blockwise holds one [8,128,32768] block
+    assert blockwise < 0.6 * full, (blockwise, full)

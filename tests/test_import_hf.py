@@ -274,3 +274,60 @@ def test_imported_model_trains_distributed(devices8):
         state, m = ad.step(state, batch)
         losses.append(float(m["loss"]))
     assert losses[-1] < losses[0]  # it learns from the imported weights
+
+
+def test_llama_raw_state_dict_requires_explicit_heads():
+    """ADVICE r3: head_dim is unrecoverable from weight shapes, so a raw
+    state_dict must be refused unless n_heads/n_kv_heads are passed —
+    and with them it must produce logits identical to the config-attached
+    import."""
+    cfg = transformers.LlamaConfig(
+        vocab_size=96, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=32,
+        rms_norm_eps=1e-5, tie_word_embeddings=False,
+    )
+    torch.manual_seed(5)
+    hf = transformers.LlamaForCausalLM(cfg).eval()
+    with pytest.raises(ValueError, match="n_heads"):
+        import_hf_llama(hf.state_dict(), max_seq_len=32)
+    model, variables = import_hf_llama(
+        hf.state_dict(), max_seq_len=32, dtype=jnp.float32,
+        n_heads=4, n_kv_heads=2,
+    )
+    assert model.cfg.n_heads == 4 and model.cfg.n_kv_heads == 2
+    tokens = np.random.RandomState(6).randint(0, 96, (1, 9))
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens)).logits.numpy()
+    got = _logits_ours(model, variables, tokens)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_mixtral_raw_state_dict_defaults_rope_theta_1e6():
+    """ADVICE r3: every released Mixtral uses rope_theta=1e6; a raw
+    state_dict import must not silently fall back to the Llama 1e4."""
+    from torch_automatic_distributed_neural_network_tpu.models.import_hf import (
+        import_hf_mixtral,
+    )
+
+    cfg = transformers.MixtralConfig(
+        vocab_size=96, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=1, num_attention_heads=2,
+        num_key_value_heads=2, max_position_embeddings=32,
+        num_local_experts=4, num_experts_per_tok=2,
+        rope_theta=1e6, rms_norm_eps=1e-5, tie_word_embeddings=False,
+    )
+    torch.manual_seed(7)
+    hf = transformers.MixtralForCausalLM(cfg).eval()
+    model, variables = import_hf_mixtral(
+        hf.state_dict(), max_seq_len=32, dtype=jnp.float32,
+        n_heads=2, n_kv_heads=2,
+    )
+    assert model.cfg.rope_theta == 1e6
+    tokens = np.random.RandomState(8).randint(0, 96, (1, 7))
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens)).logits.numpy()
+    logits, _aux = jax.jit(model.apply)(variables, jnp.asarray(tokens))
+    np.testing.assert_allclose(
+        np.asarray(logits), ref, rtol=5e-4, atol=5e-4
+    )

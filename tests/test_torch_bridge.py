@@ -1,0 +1,361 @@
+"""from_torch(nn.Module) bridge parity vs torch CPU (VERDICT r3 #3).
+
+The reference's promise is that an UNMODIFIED torch nn.Module runs
+distributed (BASELINE.json:5).  These tests pin the bridge's numerics
+against torch itself: logits parity (eval + BN-train modes), grad parity
+through jax.grad vs torch autograd, running-stat updates, and the
+end-to-end handoff into AutoDistribute on the 8-device sim.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn as tnn
+import torch.nn.functional as F
+
+from torch_automatic_distributed_neural_network_tpu.models import (  # noqa: E402
+    UnsupportedTorchModule,
+    from_torch,
+)
+
+RTOL = ATOL = 2e-5
+
+
+def _np32(t):
+    return t.detach().numpy().astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# models under test
+# ---------------------------------------------------------------------------
+
+def make_mlp():
+    torch.manual_seed(0)
+    return tnn.Sequential(
+        tnn.Flatten(),
+        tnn.Linear(64, 128), tnn.ReLU(),
+        tnn.Linear(128, 64), tnn.GELU(),
+        tnn.LayerNorm(64),
+        tnn.Linear(64, 10),
+    )
+
+
+class SmallCNN(tnn.Module):
+    """Hand-written forward (not Sequential): conv/bn/pool/residual add/
+    flatten-by-view — the reference's CNN example class."""
+
+    def __init__(self):
+        super().__init__()
+        torch.manual_seed(1)
+        self.conv1 = tnn.Conv2d(3, 8, 3, padding=1)
+        self.bn1 = tnn.BatchNorm2d(8)
+        self.conv2 = tnn.Conv2d(8, 8, 3, padding=1, bias=False)
+        self.bn2 = tnn.BatchNorm2d(8)
+        self.pool = tnn.MaxPool2d(2)
+        self.head = tnn.Linear(8 * 4 * 4, 10)
+
+    def forward(self, x):
+        x = F.relu(self.bn1(self.conv1(x)))
+        y = self.bn2(self.conv2(x))
+        x = F.relu(x + y)          # residual
+        x = self.pool(x)
+        x = F.avg_pool2d(x, 2)
+        x = x.view(x.size(0), -1)
+        return self.head(x)
+
+
+class TinyAttentionLM(tnn.Module):
+    """Hand-written causal self-attention block: embedding, qkv chunk,
+    tril mask + masked_fill, matmul/softmax, transpose/view plumbing —
+    the vocabulary a from-scratch torch GPT uses."""
+
+    def __init__(self, vocab=61, d=32, heads=4, seq=12):
+        super().__init__()
+        torch.manual_seed(2)
+        self.emb = tnn.Embedding(vocab, d)
+        self.pos = tnn.Parameter(torch.randn(1, seq, d) * 0.02)
+        self.qkv = tnn.Linear(d, 3 * d)
+        self.proj = tnn.Linear(d, d)
+        self.ln = tnn.LayerNorm(d)
+        self.head = tnn.Linear(d, vocab, bias=False)
+        self.heads = heads
+        self.register_buffer("mask", torch.tril(torch.ones(seq, seq)))
+
+    def forward(self, idx):
+        b, t = idx.size(0), idx.size(1)
+        x = self.emb(idx) + self.pos[:, :t]
+        h = self.ln(x)
+        q, k, v = self.qkv(h).chunk(3, dim=-1)
+        hd = q.size(-1) // self.heads
+        q = q.view(b, t, self.heads, hd).transpose(1, 2)
+        k = k.view(b, t, self.heads, hd).transpose(1, 2)
+        v = v.view(b, t, self.heads, hd).transpose(1, 2)
+        att = torch.matmul(q, k.transpose(-2, -1)) / (hd ** 0.5)
+        att = att.masked_fill(self.mask[:t, :t] == 0, float("-inf"))
+        att = torch.softmax(att, dim=-1)
+        out = torch.matmul(att, v).transpose(1, 2).contiguous().view(b, t, -1)
+        x = x + self.proj(out)
+        return self.head(x)
+
+
+# ---------------------------------------------------------------------------
+# logits parity
+# ---------------------------------------------------------------------------
+
+def test_mlp_logits_parity():
+    net = make_mlp().eval()
+    model, variables = from_torch(net)
+    x = np.random.RandomState(0).randn(4, 8, 8).astype(np.float32)
+    with torch.no_grad():
+        ref = net(torch.tensor(x)).numpy()
+    got = np.asarray(jax.jit(model.apply)(variables, jnp.asarray(x)))
+    np.testing.assert_allclose(got, ref, rtol=RTOL, atol=ATOL)
+
+
+def test_cnn_eval_logits_parity():
+    net = SmallCNN().eval()
+    model, variables = from_torch(net)
+    x = np.random.RandomState(1).randn(2, 3, 16, 16).astype(np.float32)
+    with torch.no_grad():
+        ref = net(torch.tensor(x)).numpy()
+    got = np.asarray(jax.jit(model.apply)(variables, jnp.asarray(x)))
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_attention_lm_logits_parity():
+    net = TinyAttentionLM().eval()
+    model, variables = from_torch(net)
+    idx = np.random.RandomState(2).randint(0, 61, (3, 12))
+    with torch.no_grad():
+        ref = net(torch.tensor(idx)).numpy()
+    got = np.asarray(jax.jit(model.apply)(variables, jnp.asarray(idx)))
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_cnn_batchnorm_train_mode_parity():
+    """train=True: batch statistics are used AND running stats update
+    exactly as torch's (momentum blend, unbiased running var)."""
+    net = SmallCNN().train()
+    model, variables = from_torch(net)
+    x = np.random.RandomState(3).randn(4, 3, 16, 16).astype(np.float32)
+
+    got, updates = model.apply(
+        variables, jnp.asarray(x), train=True, mutable=["batch_stats"])
+    ref = net(torch.tensor(x)).detach().numpy()  # torch train forward
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-4, atol=1e-4)
+
+    # running stats after one train step
+    np.testing.assert_allclose(
+        np.asarray(updates["batch_stats"]["bn1//mean"]),
+        _np32(net.bn1.running_mean), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(updates["batch_stats"]["bn1//var"]),
+        _np32(net.bn1.running_var), rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# grad parity
+# ---------------------------------------------------------------------------
+
+def _torch_grads(net, loss):
+    net.zero_grad()
+    loss.backward()
+    return {name: p.grad.detach().numpy()
+            for name, p in net.named_parameters()}
+
+
+def _check_grads(jgrads, tgrads, mapping):
+    for jkey, (tkey, transform) in mapping.items():
+        got = np.asarray(jgrads[jkey])
+        ref = transform(tgrads[tkey])
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4,
+                                   err_msg=jkey)
+
+
+def test_mlp_grad_parity():
+    net = make_mlp().eval()
+    model, variables = from_torch(net)
+    x = np.random.RandomState(4).randn(4, 8, 8).astype(np.float32)
+
+    xt = torch.tensor(x)
+    tloss = net(xt).pow(2).mean()
+    tgrads = _torch_grads(net, tloss)
+
+    def jloss(params):
+        out = model.apply({"params": params}, jnp.asarray(x))
+        return (out ** 2).mean()
+
+    jgrads = jax.grad(jloss)(variables["params"])
+    _check_grads(jgrads, tgrads, {
+        "1//kernel": ("1.weight", lambda w: w.T),
+        "1//bias": ("1.bias", lambda b: b),
+        "3//kernel": ("3.weight", lambda w: w.T),
+        "5//scale": ("5.weight", lambda w: w),
+        "5//bias": ("5.bias", lambda b: b),
+        "6//kernel": ("6.weight", lambda w: w.T),
+    })
+
+
+def test_cnn_grad_parity_eval_mode():
+    net = SmallCNN().eval()  # eval: BN uses running stats on both sides
+    model, variables = from_torch(net)
+    x = np.random.RandomState(5).randn(2, 3, 16, 16).astype(np.float32)
+
+    tloss = net(torch.tensor(x)).pow(2).mean()
+    tgrads = _torch_grads(net, tloss)
+
+    def jloss(params):
+        out = model.apply(
+            {"params": params, "batch_stats": variables["batch_stats"]},
+            jnp.asarray(x))
+        return (out ** 2).mean()
+
+    jgrads = jax.grad(jloss)(variables["params"])
+    _check_grads(jgrads, tgrads, {
+        "conv1//kernel": ("conv1.weight", lambda w: w),  # OIHW kept
+        "conv1//bias": ("conv1.bias", lambda b: b),
+        "conv2//kernel": ("conv2.weight", lambda w: w),
+        "bn1//scale": ("bn1.weight", lambda w: w),
+        "bn2//bias": ("bn2.bias", lambda b: b),
+        "head//kernel": ("head.weight", lambda w: w.T),
+    })
+
+
+def test_attention_lm_grad_parity():
+    net = TinyAttentionLM().eval()
+    model, variables = from_torch(net)
+    idx = np.random.RandomState(6).randint(0, 61, (2, 12))
+
+    tloss = net(torch.tensor(idx)).pow(2).mean()
+    tgrads = _torch_grads(net, tloss)
+
+    def jloss(params):
+        out = model.apply(
+            {"params": params, "constants": variables["constants"]},
+            jnp.asarray(idx))
+        return (out ** 2).mean()
+
+    jgrads = jax.grad(jloss)(variables["params"])
+    _check_grads(jgrads, tgrads, {
+        "emb//embedding": ("emb.weight", lambda w: w),
+        "pos//value": ("pos", lambda w: w),
+        "qkv//kernel": ("qkv.weight", lambda w: w.T),
+        "head//kernel": ("head.weight", lambda w: w.T),
+    })
+
+
+# ---------------------------------------------------------------------------
+# semantics details
+# ---------------------------------------------------------------------------
+
+def test_dropout_train_vs_eval():
+    torch.manual_seed(7)
+    net = tnn.Sequential(tnn.Linear(16, 16), tnn.Dropout(0.5),
+                         tnn.Linear(16, 4))
+    model, variables = from_torch(net)
+    x = jnp.ones((8, 16))
+    eval_out = model.apply(variables, x)
+    eval_out2 = model.apply(variables, x)
+    np.testing.assert_array_equal(np.asarray(eval_out),
+                                  np.asarray(eval_out2))
+    t1 = model.apply(variables, x, train=True,
+                     rngs={"dropout": jax.random.key(0)})
+    t2 = model.apply(variables, x, train=True,
+                     rngs={"dropout": jax.random.key(1)})
+    assert not np.allclose(np.asarray(t1), np.asarray(t2))
+
+
+def test_weight_sharing_single_param():
+    """A module applied twice traces to two call_module nodes on ONE
+    param set — grads must accumulate through both uses."""
+
+    class Shared(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            torch.manual_seed(8)
+            self.lin = tnn.Linear(8, 8)
+
+        def forward(self, x):
+            return self.lin(F.relu(self.lin(x)))
+
+    net = Shared().eval()
+    model, variables = from_torch(net)
+    assert list(variables["params"]) == ["lin//kernel", "lin//bias"]
+    x = np.random.RandomState(9).randn(3, 8).astype(np.float32)
+    tloss = net(torch.tensor(x)).pow(2).mean()
+    tgrads = _torch_grads(net, tloss)
+
+    def jloss(params):
+        return (model.apply({"params": params}, jnp.asarray(x)) ** 2).mean()
+
+    jgrads = jax.grad(jloss)(variables["params"])
+    np.testing.assert_allclose(np.asarray(jgrads["lin//kernel"]),
+                               tgrads["lin.weight"].T,
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_unsupported_module_raises():
+    net = tnn.Sequential(tnn.Linear(4, 4), tnn.LSTM(4, 4))
+    with pytest.raises(UnsupportedTorchModule):
+        from_torch(net)
+
+
+def test_init_matches_converted_tree_structure():
+    """model.init (zeros) and from_torch's converted variables must have
+    identical tree structure, so AutoDistribute's sharded-init path and
+    init_fn=converted-variables are interchangeable."""
+    net = SmallCNN()
+    model, variables = from_torch(net)
+    x = jnp.zeros((1, 3, 16, 16))
+    initd = model.init(jax.random.key(0), x)
+    assert jax.tree_util.tree_structure(
+        {k: initd[k] for k in ("params", "batch_stats")}
+    ) == jax.tree_util.tree_structure(
+        {k: variables[k] for k in ("params", "batch_stats")})
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: AutoDistribute over the bridge
+# ---------------------------------------------------------------------------
+
+def test_autodistribute_trains_bridged_cnn(devices8):
+    import optax
+
+    from torch_automatic_distributed_neural_network_tpu import AutoDistribute
+    from torch_automatic_distributed_neural_network_tpu.training import (
+        softmax_xent_loss_mutable,
+    )
+
+    net = SmallCNN()
+    model, variables = from_torch(net)
+    rs = np.random.RandomState(10)
+    batch = {"x": rs.randn(16, 3, 16, 16).astype(np.float32),
+             "label": rs.randint(0, 10, (16,))}
+
+    def loss_fn(params, model_state, batch, rng, apply_fn):
+        variables = {"params": params, **model_state}
+        logits, updates = apply_fn(
+            variables, batch["x"], train=True,
+            mutable=list(model_state.keys()))
+        import optax as _optax
+        loss = _optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"]).mean()
+        return loss, {"model_state": updates}
+
+    ad = AutoDistribute(
+        model,
+        optimizer=optax.sgd(0.05),
+        loss_fn=loss_fn,
+        strategy="dp",
+        devices=jax.devices(),
+        init_fn=lambda rng, b: variables,
+    )
+    state = ad.init(jax.random.key(0), batch)
+    losses = []
+    for _ in range(4):
+        state, metrics = ad.step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]

@@ -107,8 +107,12 @@ class AutoDistribute:
         inputs bounded by 2S-1 instead of M+S-1 — the schedule for large
         microbatch counts; costs one extra forward wavefront, ~25% more
         step FLOPs than the remat-everything policy, in exchange for the
-        M-independent memory bound).
+        M-independent memory bound) | 'interleaved' (Megatron V virtual
+        stages per device via ``pipeline_virtual``: bubble shrinks
+        V-fold to (S-1)/(MV+S-1); microbatches % stages must be 0).
         All trajectory-identical; see parallel/pipeline.py.
+    pipeline_virtual:
+        V for pipeline_schedule='interleaved'; ignored otherwise.
     grad_accum:
         Accumulate gradients over this many sequential slices of every
         batch before the (single) optimizer update — train with k x the
@@ -137,6 +141,7 @@ class AutoDistribute:
         pipeline_stages: int = 1,
         microbatches: int = 8,
         pipeline_schedule: str = "cond",
+        pipeline_virtual: int = 1,
         precision: str | precision_mod.Precision = "fp32",
         grad_accum: int = 1,
     ):
@@ -184,6 +189,7 @@ class AutoDistribute:
         self._pipeline_stages = pipeline_stages
         self._microbatches = microbatches
         self._pipeline_schedule = pipeline_schedule
+        self._pipeline_virtual = pipeline_virtual
         if grad_accum < 1:
             raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
         self._grad_accum = grad_accum
@@ -255,6 +261,14 @@ class AutoDistribute:
                     "pipeline parallelism does not support stateful models "
                     "(batch stats) yet"
                 )
+            if getattr(self._loss_fn, "requires_features", False):
+                raise ValueError(
+                    "blockwise_next_token_loss cannot run under pipeline "
+                    "parallelism: the pipelined apply applies the lm_head "
+                    "itself and has no return_features path — use "
+                    "next_token_loss (the [B,S,V] logits are per-microbatch "
+                    "there, already 1/M the size)"
+                )
             from .parallel import pipeline as pipe_mod
 
             # GPipe over the scanned layer stack; remat is applied inside
@@ -266,6 +280,7 @@ class AutoDistribute:
                 n_microbatches=self._microbatches,
                 remat=self._remat,
                 schedule=self._pipeline_schedule,
+                virtual=self._pipeline_virtual,
             )
             self.plan.remat = False
         return self.plan

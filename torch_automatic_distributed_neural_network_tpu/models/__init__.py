@@ -13,6 +13,7 @@ from .llama import Llama, llama_config
 from .mlp import MLP
 from .moe import MoE, MoEConfig, MoELM, moe_config
 from .resnet import ResNet, ResNet18Thin, ResNet50, ResNetConfig
+from .torch_bridge import TorchBridge, UnsupportedTorchModule, from_torch
 from .transformer_core import DecoderLM, TransformerConfig
 from .transformer_mt import Seq2SeqTransformer, TransformerMT
 
@@ -21,6 +22,9 @@ __all__ = [
     "GPT2",
     "gpt2_config",
     "import_hf_gpt2",
+    "TorchBridge",
+    "UnsupportedTorchModule",
+    "from_torch",
     "import_hf_llama",
     "import_hf_mixtral",
     "export_hf_gpt2",

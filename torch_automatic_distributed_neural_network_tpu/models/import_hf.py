@@ -171,7 +171,8 @@ class _LlamaCommon:
     """The dims/config/attention plumbing shared by every Llama-family
     HF layout (Llama and Mixtral differ only in the MLP block)."""
 
-    def __init__(self, model_or_state_dict, max_seq_len, rope_theta=None):
+    def __init__(self, model_or_state_dict, max_seq_len, rope_theta=None,
+                 n_heads=None, n_kv_heads=None):
         sd = self.sd = _state_dict(model_or_state_dict)
         hf_cfg = self.hf_cfg = getattr(model_or_state_dict, "config", None)
         self.rope_theta = (
@@ -191,18 +192,27 @@ class _LlamaCommon:
             self.n_layers += 1
         q0 = self.g("layers.0.self_attn.q_proj.weight")  # [H*hd, d]
         k0 = self.g("layers.0.self_attn.k_proj.weight")  # [KV*hd, d]
-        # head counts: from the attached config when present; raw
-        # state_dicts fall back to the Llama-family head_dim convention
-        # (128 for the 8B/70B-scale widths, 64 below)
-        if hf_cfg is not None and hasattr(hf_cfg, "num_attention_heads"):
+        # head counts: explicit kwargs win; else the attached config; a
+        # raw state_dict is REFUSED rather than guessed — head_dim is not
+        # recoverable from weight shapes (TinyLlama-1.1B has d=2048 with
+        # 64-dim heads, Llama-8B d=4096 with 128-dim heads; any
+        # convention silently mis-reshapes one of them into garbage)
+        if n_heads is not None:
+            self.n_heads = int(n_heads)
+            self.n_kv = int(n_kv_heads if n_kv_heads is not None
+                            else n_heads)
+        elif hf_cfg is not None and hasattr(hf_cfg, "num_attention_heads"):
             self.n_heads = int(hf_cfg.num_attention_heads)
             self.n_kv = int(
                 getattr(hf_cfg, "num_key_value_heads", self.n_heads)
             )
         else:
-            hd_guess = 128 if self.d >= 2048 else 64
-            self.n_heads = q0.shape[0] // hd_guess
-            self.n_kv = k0.shape[0] // hd_guess
+            raise ValueError(
+                "raw state_dict has no attached config: head layout is "
+                "ambiguous (head_dim cannot be inferred from weight "
+                "shapes) — pass n_heads= and n_kv_heads= explicitly, or "
+                "import via the transformers model object"
+            )
         self.hd = q0.shape[0] // self.n_heads
         # HF materializes lm_head.weight in state_dict() even when tied
         # (same storage as embed_tokens).  A bare backbone has no head
@@ -280,15 +290,19 @@ class _LlamaCommon:
 def import_hf_llama(
     model_or_state_dict, *, max_seq_len: int | None = None,
     rope_theta: float | None = None, dtype: Any = None,
+    n_heads: int | None = None, n_kv_heads: int | None = None,
 ) -> tuple[DecoderLM, dict]:
     """HF ``LlamaForCausalLM`` / ``LlamaModel`` -> (our Llama, variables).
 
     torch ``nn.Linear`` stores ``[out, in]``; every projection transposes
-    into our ``[in, ...]`` kernels.  GQA dims are read from the k_proj
-    shape.  ``rope_theta`` defaults from the model config when one is
-    attached (HF Llama-3 uses 500000.0), else 10000.0.
+    into our ``[in, ...]`` kernels.  ``rope_theta`` defaults from the
+    model config when one is attached (HF Llama-3 uses 500000.0), else
+    10000.0.  Raw state_dicts (no attached config) must pass ``n_heads``
+    / ``n_kv_heads`` explicitly — head_dim is not recoverable from
+    weight shapes.
     """
-    c = _LlamaCommon(model_or_state_dict, max_seq_len, rope_theta)
+    c = _LlamaCommon(model_or_state_dict, max_seq_len, rope_theta,
+                     n_heads=n_heads, n_kv_heads=n_kv_heads)
     ff = c.g("layers.0.mlp.gate_proj.weight").shape[0]
     cfg = TransformerConfig(d_ff=ff, **c.cfg_kwargs(dtype))
     layers = []
@@ -475,6 +489,7 @@ def import_hf_mixtral(
     model_or_state_dict, *, max_seq_len: int | None = None,
     rope_theta: float | None = None,
     capacity_factor: float | None = None, dtype: Any = None,
+    n_heads: int | None = None, n_kv_heads: int | None = None,
 ):
     """HF ``MixtralForCausalLM`` / ``MixtralModel`` -> (our MoELM,
     variables).
@@ -492,10 +507,14 @@ def import_hf_mixtral(
     """
     from .moe import MoEConfig, MoELM
 
-    # raw Mixtral state_dicts need the override: every released Mixtral
-    # uses rope_theta=1e6, but without an attached config the fallback
-    # is the Llama default 1e4
-    c = _LlamaCommon(model_or_state_dict, max_seq_len, rope_theta)
+    # every released Mixtral uses rope_theta=1e6, so that is the default
+    # for raw state_dicts (no attached config); _LlamaCommon's own
+    # fallback is the Llama 1e4, which is wrong for every Mixtral
+    if (rope_theta is None
+            and getattr(model_or_state_dict, "config", None) is None):
+        rope_theta = 1e6
+    c = _LlamaCommon(model_or_state_dict, max_seq_len, rope_theta,
+                     n_heads=n_heads, n_kv_heads=n_kv_heads)
     n_experts = 0
     while (f"model.layers.0.block_sparse_moe.experts.{n_experts}.w1.weight"
            in c.sd

@@ -107,9 +107,11 @@ class MoELM(nn.Module):
     cfg: MoEConfig
 
     @nn.compact
-    def __call__(self, tokens, positions=None, mask=None):
+    def __call__(self, tokens, positions=None, mask=None,
+                 return_features: bool = False):
         return apply_decoder_backbone(
-            self, self.cfg, tokens, positions, mask, MoEDecoderLayer
+            self, self.cfg, tokens, positions, mask, MoEDecoderLayer,
+            return_features=return_features,
         )
 
 

@@ -208,6 +208,7 @@ def apply_decoder_backbone(
     positions,
     mask,
     layer_base: type[nn.Module],
+    return_features: bool = False,
 ):
     """Shared decoder body: embed -> (remat'd, scanned) layer stack -> norm
     -> tied/untied head.
@@ -217,7 +218,12 @@ def apply_decoder_backbone(
     "lm_head") is identical for every family.  ``layer_base`` may return
     either ``x`` (dense layers) or ``(x, aux)`` (MoE layers — aux router
     losses); the scan carry threads the aux sum functionally either way.
-    Returns ``(logits, aux_total)``.
+    Returns ``(logits, aux_total)`` — or, with ``return_features=True``,
+    ``(post-final-norm hidden states, aux_total)`` WITHOUT applying the
+    LM head: the fp32 ``[B,S,V]`` logits tensor is the dominant memory
+    temp at large vocab (Llama-3: 128k), and ``training.losses.
+    blockwise_next_token_loss`` consumes features + head weights to
+    compute the loss without ever materializing it.
     """
     if positions is None:
         positions = jnp.arange(tokens.shape[1])[None, :]
@@ -273,6 +279,8 @@ def apply_decoder_backbone(
             )
 
     x = make_norm(cfg, "final_norm")(x)
+    if return_features:
+        return x, aux_total
     if cfg.tie_embeddings:
         logits = embed.attend(x.astype(jnp.float32))
     else:
@@ -289,8 +297,10 @@ class DecoderLM(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, positions=None, mask=None):
-        logits, _ = apply_decoder_backbone(
-            self, self.cfg, tokens, positions, mask, DecoderLayer
+    def __call__(self, tokens, positions=None, mask=None,
+                 return_features: bool = False):
+        out, _ = apply_decoder_backbone(
+            self, self.cfg, tokens, positions, mask, DecoderLayer,
+            return_features=return_features,
         )
-        return logits
+        return out

@@ -4,6 +4,13 @@ Implementations:
 
 - ``xla``   — plain jnp einsum attention; XLA fuses it well for moderate
               sequence lengths and it runs everywhere (CPU sim included).
+- ``chunked`` — query-block scan over the same einsum math with fp32
+              online numerics and per-block rematerialization: peak
+              score memory O(block_q * S) instead of O(S^2), pure XLA,
+              runs everywhere and takes explicit masks.  The auto path
+              uses it for long sequences whenever the Pallas kernel
+              can't run (non-TPU backends, explicit masks) — it is what
+              keeps long-seq memfit numbers honest off-TPU.
 - ``flash`` — Pallas block-streaming attention (ops/flash_attention.py),
               O(seq) memory, MXU-tiled; TPU only.
 - ``ring``  — context-parallel ring attention (parallel/ring.py): KV blocks
@@ -26,7 +33,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-Impl = Literal["xla", "flash", "ring", "auto"]
+Impl = Literal["xla", "chunked", "flash", "ring", "auto"]
+
+# auto-dispatch floor for the chunked path off-TPU: below this the full
+# S^2 score tensor is small enough that the plain einsum fuses better
+CHUNKED_MIN_SEQ = 1024
 
 
 def _mask_bias(scores_dtype, mask):
@@ -61,6 +72,73 @@ def xla_attention(
         scores = scores + _mask_bias(scores.dtype, mask)
     probs = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+
+
+def chunked_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    mask: jax.Array | None = None,
+    block_q: int = 256,
+    softmax_dtype=jnp.float32,
+) -> jax.Array:
+    """Memory-efficient einsum attention: lax.scan over query blocks.
+
+    Numerically identical to :func:`xla_attention` (same fp32 softmax,
+    same GQA broadcast, same mask conventions) but the [B,H,S,S] score
+    tensor never materializes — each scan step holds [B,H,block_q,S],
+    and ``jax.checkpoint`` on the block recomputes scores in the
+    backward instead of stashing them per block.  This is the flash
+    algorithm's memory shape in pure XLA, so it runs on any backend and
+    supports explicit masks (which the Pallas kernel does not).
+    """
+    b, sq, hq, d = q.shape
+    _, sk, hk, _ = k.shape
+    if hk != hq:
+        assert hq % hk == 0, (hq, hk)
+        k = jnp.repeat(k, hq // hk, axis=2)
+        v = jnp.repeat(v, hq // hk, axis=2)
+    block_q = min(block_q, sq)
+    n_blocks = -(-sq // block_q)
+    pad = n_blocks * block_q - sq
+    if pad:
+        q = jnp.pad(q, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        if mask is not None and mask.shape[2] > 1:
+            # keep mask rows aligned with padded q rows (a fully-False
+            # row yields a uniform softmax via the finite mask bias; the
+            # row's output is sliced off below)
+            mask = jnp.pad(mask, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    q_blocks = q.reshape(b, n_blocks, block_q, hq, d).swapaxes(0, 1)
+    scale = 1.0 / np.sqrt(d)
+    k_pos = jnp.arange(sk)
+
+    @jax.checkpoint
+    def block(q_i, start):
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q_i, k).astype(
+            softmax_dtype) * scale
+        if causal:
+            # global q position p attends key positions <= p + (sk - sq)
+            q_pos = start + jnp.arange(block_q)
+            allow = k_pos[None, :] <= q_pos[:, None] + (sk - sq)
+            scores = scores + _mask_bias(scores.dtype, allow[None, None])
+        if mask is not None:
+            m = mask
+            if m.shape[2] > 1:  # [B, 1|H, Q, K]: slice this block's rows
+                m = jax.lax.dynamic_slice_in_dim(m, start, block_q, axis=2)
+            scores = scores + _mask_bias(scores.dtype, m)
+        probs = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+
+    def body(_, inp):
+        q_i, start = inp
+        return None, block(q_i, start)
+
+    _, out = jax.lax.scan(
+        body, None, (q_blocks, jnp.arange(n_blocks) * block_q))
+    out = out.swapaxes(0, 1).reshape(b, n_blocks * block_q, hq, d)
+    return out[:, :sq]
 
 
 def _flash_ok(q: jax.Array, k: jax.Array, mask) -> bool:
@@ -118,11 +196,18 @@ def attention(
                     impl = "ring"
         elif _flash_ok(q, k, mask):
             impl = "flash"
+        elif q.shape[1] >= CHUNKED_MIN_SEQ and q.shape[1] == k.shape[1]:
+            # long sequence but the Pallas kernel can't run (non-TPU
+            # backend or explicit mask): O(block*S) memory via the
+            # query-block scan instead of the S^2 einsum
+            impl = "chunked"
         else:
             impl = "xla"
 
     if impl == "xla":
         return xla_attention(q, k, v, causal=causal, mask=mask)
+    if impl == "chunked":
+        return chunked_attention(q, k, v, causal=causal, mask=mask)
     if impl == "flash":
         from .flash_attention import flash_attention
 

@@ -45,17 +45,21 @@ round-2 compute-everything-and-mask behavior for A/B measurement
 AD-through-the-scan with a hand-scheduled backward (onef_oneb_grads):
 M-independent live-activation memory.
 
-Not implemented (design note for a future round): the Megatron
-*interleaved* schedule — V virtual stages per device, bubble fraction
-shrinking to ~(S-1)/(VM+S-1).  The layout that makes it free of weight
-movement: view the stacked ``[L, ...]`` layer dim as ``[V, S, C]``
-(pure reshape — natural layer (vS+s)C+j lands at index (v, s, j)) and
-shard dim 1 on ``pipe``; each device then holds exactly its V
-round-robin blocks with NO gather/all-to-all, and the ring permutation
-(i -> i+1) already visits virtual stages in order.  The costs that kept
-it out of this round: the train-state layout changes rank (checkpoints
-/ decode paths need a reshape-aware spec), and each lockstep tick runs
-up to V stage blocks, so the scan body and the stash ring grow V-fold.
+``schedule='interleaved'`` (round 4) implements the Megatron
+interleaved schedule with ``V`` virtual stages per device
+(:func:`spmd_pipeline_interleaved`): the stacked ``[L, ...]`` layer dim
+is VIEWED as ``[V, S, C]`` (pure reshape — natural layer (vS+s)C+j
+lands at index (v, s, j)) and dim 1 is sharded on ``pipe``, so each
+device holds exactly its V round-robin chunks with NO gather or
+all-to-all, and the existing ring permutation (i -> i+1) already
+delivers the right activation every tick.  Each tick runs ONE chunk of
+``C = L/(SV)`` layers (capacity-1, the real Megatron discipline — not
+the V-chunks-per-tick layout sketch), so the forward takes
+``MV + S - 1`` ticks and the bubble fraction shrinks V-fold to
+``(S-1)/(MV + S - 1)``.  Constraint: ``M % S == 0`` (Megatron's
+microbatch grouping).  Backward is reverse-mode AD through the scan
+(GPipe-style), so live stash grows to MV chunk inputs — interleaved ×
+1f1b (which would bound that) is not implemented.
 """
 
 from __future__ import annotations
@@ -188,6 +192,96 @@ def spmd_pipeline(
     # materializing all-reduce(copy) the partial-manual boundary emits
     # trips a CHECK in XLA:CPU's AllReducePromotion pass when it is bf16
     # (callers cast back outside the region).
+    masked = jnp.where(stage == S - 1, outputs, jnp.zeros_like(outputs))
+    return jax.lax.psum(masked.astype(jnp.float32), axis_name)
+
+
+def spmd_pipeline_interleaved(
+    stage_fn: Callable[[Any, jax.Array, jax.Array, jax.Array], jax.Array],
+    stage_params: Any,
+    microbatches: jax.Array,
+    *,
+    n_stages: int,
+    virtual: int,
+    axis_name: str = "pipe",
+    schedule: str = "cond",
+) -> jax.Array:
+    """Megatron interleaved forward: V virtual stages per device.
+
+    Must run inside `shard_map` manual over ``axis_name``.
+    ``stage_params`` leaves are ``[V, C, ...]`` per device (the global
+    ``[V, S, C]`` view sharded on dim 1); ``stage_fn(chunk_params, x,
+    mb_idx, v_idx)`` applies one C-layer chunk.
+
+    Chunk q = v*S + s lives on device s = q % S — so the chain q -> q+1
+    is exactly the ring hop i -> i+1, except the wrap S-1 -> 0 advances
+    the virtual index, and v=0 on device 0 ingests fresh microbatches.
+    Device s's k-th chunk execution (at tick t = s + k) handles::
+
+        v = (k // S) % V
+        m = (k // (S*V)) * S + k % S        (requires M % S == 0)
+
+    This order satisfies both dependencies tick-tight: the same-(v,m)
+    producer on device s-1 finished at t-1, and device 0's (v,m) needs
+    (v-1,m) from device S-1, which finished at t-1 as well (k differs by
+    exactly S).  ``M*V + S - 1`` ticks of one C-layer chunk each.
+    """
+    if schedule not in ("cond", "dense"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    S, V = n_stages, virtual
+    M = microbatches.shape[0]
+    if M % S:
+        raise ValueError(
+            f"interleaved schedule needs microbatches % stages == 0 "
+            f"(Megatron grouping); got M={M}, S={S}"
+        )
+    stage = jax.lax.axis_index(axis_name)
+    microbatches = _to_varying(microbatches, axis_name)
+
+    act0 = jnp.zeros_like(microbatches[0])
+    outputs0 = jnp.zeros_like(microbatches)
+    perm = [(i, (i + 1) % S) for i in range(S)]
+    T = M * V + S - 1
+
+    def body(carry, t):
+        act, outputs = carry
+        k = t - stage  # this device's chunk-execution index
+        work = jnp.logical_and(k >= 0, k < M * V)
+        kc = jnp.clip(k, 0, M * V - 1)
+        v = (kc // S) % V
+        m = (kc // (S * V)) * S + kc % S
+        # v=0 on device 0 ingests microbatch m; everything else takes
+        # the ring activation (see the tick-tightness argument above)
+        inp = jnp.where(
+            jnp.logical_and(stage == 0, v == 0),
+            jax.lax.dynamic_index_in_dim(microbatches, m, 0, keepdims=False),
+            act,
+        )
+        chunk_params = jax.tree.map(
+            lambda p: jax.lax.dynamic_index_in_dim(p, v, 0, keepdims=False),
+            stage_params,
+        )
+        if schedule == "cond":
+            out = jax.lax.cond(
+                work,
+                lambda a: stage_fn(chunk_params, a, m, v),
+                lambda a: a,
+                inp,
+            )
+        else:
+            out = stage_fn(chunk_params, inp, m, v)
+        # the chain's last chunk (v = V-1 on device S-1) completes m
+        is_done = jnp.logical_and(
+            jnp.logical_and(stage == S - 1, v == V - 1), work
+        )
+        cur = jax.lax.dynamic_index_in_dim(outputs, m, 0, keepdims=False)
+        outputs = jax.lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(is_done, out, cur), m, 0
+        )
+        nxt = jax.lax.ppermute(out, axis_name, perm)
+        return (nxt, outputs), None
+
+    (_, outputs), _ = jax.lax.scan(body, (act0, outputs0), jnp.arange(T))
     masked = jnp.where(stage == S - 1, outputs, jnp.zeros_like(outputs))
     return jax.lax.psum(masked.astype(jnp.float32), axis_name)
 
@@ -372,6 +466,7 @@ def make_pipelined_apply(
     axis_name: str = "pipe",
     remat: bool | None = None,
     schedule: str = "cond",
+    virtual: int = 1,
 ) -> Callable:
     """Build ``apply(variables, tokens, rngs=...) -> logits`` running
     ``model``'s layer stack as a GPipe pipeline over ``mesh``'s ``pipe``
@@ -391,7 +486,7 @@ def make_pipelined_apply(
     """
     from ..models.transformer_core import DecoderLayer, DecoderLM, make_norm
 
-    if schedule not in ("cond", "dense", "1f1b"):
+    if schedule not in ("cond", "dense", "1f1b", "interleaved"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
     if not isinstance(model, DecoderLM):
         raise TypeError(
@@ -404,12 +499,30 @@ def make_pipelined_apply(
     S = topo_mod.mesh_degrees(mesh).get(axis_name, 1)
     if S <= 1:
         raise ValueError(f"mesh has no {axis_name!r} axis > 1")
-    if cfg.n_layers % S:
+    interleaved = schedule == "interleaved"
+    V = virtual if interleaved else 1
+    if interleaved and V < 2:
         raise ValueError(
-            f"n_layers={cfg.n_layers} not divisible by {S} pipeline stages"
+            "schedule='interleaved' needs virtual >= 2 (V=1 is plain "
+            "GPipe — use schedule='cond')"
+        )
+    if not interleaved and virtual > 1:
+        raise ValueError(
+            f"virtual={virtual} only applies to schedule='interleaved'"
+        )
+    if cfg.n_layers % (S * V):
+        raise ValueError(
+            f"n_layers={cfg.n_layers} not divisible by "
+            f"{S} stages x {V} virtual"
         )
     M = n_microbatches
+    if interleaved and M % S:
+        raise ValueError(
+            f"interleaved schedule needs microbatches % stages == 0; "
+            f"got M={M}, S={S}"
+        )
     L_local = cfg.n_layers // S
+    C_chunk = cfg.n_layers // (S * V)
 
     layer = DecoderLayer(cfg)
 
@@ -436,7 +549,7 @@ def make_pipelined_apply(
         False = deterministic pass (eval): no dropout rngs are threaded,
         matching the flax missing-rng convention."""
 
-        def stage_fn(stage_params, x, mb_idx):
+        def stage_fn(stage_params, x, mb_idx, v_idx=None):
             # fp32 in/out: activations and their cotangents cross every
             # stage hop and the region boundary in fp32 (see pipe_region);
             # compute inside the stage stays in the model dtype
@@ -454,6 +567,13 @@ def make_pipelined_apply(
                 )
             )
             stage = jax.lax.axis_index(axis_name)
+            # global index of this block's first layer: contiguous
+            # L_local-sized stages, or the (v*S + s)-th C-sized chunk of
+            # the interleaved [V, S, C] view
+            layer_base = (
+                stage * L_local if v_idx is None
+                else (v_idx * S + stage) * C_chunk
+            )
 
             def body(carry, xs):
                 p, li = xs
@@ -461,17 +581,17 @@ def make_pipelined_apply(
                     # schedule-independent key: one stream per
                     # (microbatch, global layer) pair
                     base = jax.random.wrap_key_data(key_data)
-                    global_layer = stage * L_local + li
                     key = jax.random.fold_in(
-                        base, mb_idx * cfg.n_layers + global_layer
+                        base, mb_idx * cfg.n_layers + layer_base + li
                     )
                     rngs = {"dropout": key}
                 else:
                     rngs = None
                 return one_layer(p, carry, positions, mask, rngs), None
 
+            n_block = jax.tree.leaves(stage_params)[0].shape[0]
             y, _ = jax.lax.scan(
-                body, x, (stage_params, jnp.arange(L_local))
+                body, x, (stage_params, jnp.arange(n_block))
             )
             return y.astype(jnp.float32)
 
@@ -530,20 +650,36 @@ def make_pipelined_apply(
                 if schedule_override is not None:
                     eff_schedule = schedule_override
                 else:
-                    eff_schedule = "dense" if use_dropout else schedule
-                out = spmd_pipeline(
-                    make_stage_fn(key_data, positions_mbs, mask_mbs,
-                                  use_dropout),
-                    layer_params, mbs,
-                    n_stages=S, axis_name=axis_name, schedule=eff_schedule,
-                )
+                    eff_schedule = "dense" if use_dropout else "cond"
+                    if schedule in ("dense",):
+                        eff_schedule = "dense"
+                stage_fn = make_stage_fn(key_data, positions_mbs,
+                                         mask_mbs, use_dropout)
+                if interleaved:
+                    # leaves arrive [V, 1, C, ...] (the [V, S, C] view
+                    # sharded on dim 1) — drop the unit stage dim
+                    local = jax.tree.map(
+                        lambda p: p.squeeze(1), layer_params
+                    )
+                    out = spmd_pipeline_interleaved(
+                        stage_fn, local, mbs,
+                        n_stages=S, virtual=V, axis_name=axis_name,
+                        schedule=eff_schedule,
+                    )
+                else:
+                    out = spmd_pipeline(
+                        stage_fn, layer_params, mbs,
+                        n_stages=S, axis_name=axis_name,
+                        schedule=eff_schedule,
+                    )
             return out.reshape(x.shape)  # fp32 across the region boundary
 
         n_extras = int(has_pos) + int(has_mask)
+        layer_spec = P(None, axis_name) if interleaved else P(axis_name)
         return shard_map(
             pipe_region,
             mesh=mesh,
-            in_specs=(P(axis_name), P(), P()) + (P(),) * n_extras,
+            in_specs=(layer_spec, P(), P()) + (P(),) * n_extras,
             out_specs=P(),
             axis_names={axis_name},
         )
@@ -652,7 +788,17 @@ def make_pipelined_apply(
             jnp.broadcast_to(e, (B,) + e.shape[1:])
             for e in (positions, mask) if e is not None
         )
-        x = pipe(params["layers"], x.astype(jnp.float32), key_data, *extras)
+        layer_params = params["layers"]
+        if interleaved:
+            # the [V, S, C] interleaved view of the layer dim (a pure
+            # reshape: natural layer (vS+s)C+j -> index (v, s, j));
+            # sharding dim 1 on pipe hands each device its V round-robin
+            # chunks with zero weight movement
+            layer_params = jax.tree.map(
+                lambda p: p.reshape((V, S, C_chunk) + p.shape[1:]),
+                layer_params,
+            )
+        x = pipe(layer_params, x.astype(jnp.float32), key_data, *extras)
         x = x.astype(cfg.dtype)
         x = make_norm(cfg, "final_norm").apply(
             {"params": params["final_norm"]}, x

@@ -7,9 +7,11 @@ CheckpointManager`` still works and only then imports orbax.
 """
 
 from .losses import (
+    blockwise_next_token_loss,
     moe_next_token_loss,
     mse_loss,
     next_token_loss,
+    next_token_loss_mutable,
     seq2seq_loss,
     softmax_xent_loss,
     softmax_xent_loss_mutable,
@@ -37,6 +39,8 @@ __all__ = [
     "softmax_xent_loss",
     "softmax_xent_loss_mutable",
     "next_token_loss",
+    "next_token_loss_mutable",
+    "blockwise_next_token_loss",
     "moe_next_token_loss",
     "seq2seq_loss",
     "mse_loss",

@@ -46,6 +46,14 @@ class TrainerConfig:
     eval_every: int = 0  # 0 = off; run evaluate(eval_data) every N steps
     eval_batches: int = 8  # batches per periodic evaluation
     preempt_drain: bool = True  # SIGTERM -> checkpoint + clean return
+    # multi-host drain agreement runs a host-blocking allgather; doing it
+    # every step serializes host dispatch, so it is amortized to every N
+    # steps.  Drain latency is then up to N*step_time, which must fit the
+    # preemptor's SIGTERM grace window — at 8 x ~1s steps that holds for
+    # typical 30-90s windows, but for slow steps (tens of seconds on
+    # large models) set this to 1-2.  Single-process runs check the
+    # local flag every step regardless.
+    preempt_check_every: int = 8
 
 
 def _is_step_indexed(data: Any) -> bool:
@@ -218,7 +226,7 @@ class Trainer:
                     slow_block = True
                 for cb in self.callbacks:
                     cb(i + 1, state, step_metrics)
-                if self.preempt is not None and self._drain_agreed():
+                if self.preempt is not None and self._drain_agreed(i + 1):
                     # graceful drain: save where we are and return; the
                     # recovery path (restore_or_init / run_with_recovery)
                     # resumes from exactly this step on the next start
@@ -266,18 +274,23 @@ class Trainer:
                 self.ckpt.wait()
         return state
 
-    def _drain_agreed(self) -> bool:
+    def _drain_agreed(self, step: int) -> bool:
         """Cross-host agreement on the preemption drain.
 
         Each host sees only its own SIGTERM, and signals can land on
         opposite sides of a step boundary — hosts must agree on WHICH
         step to stop after, or they run mismatched collectives and hang
         through the grace window.  Single-process: just the local flag.
-        Multi-host: allgather-OR the flag every step (one tiny host
-        collective; worth it — a hung drain saves nothing at all).
+        Multi-host: allgather-OR the flag on a deterministic step
+        schedule (every ``preempt_check_every`` steps, identical on all
+        hosts so they stay in lockstep — a host's local flag must NOT
+        trigger an off-schedule collective the others won't join).
         """
         if jax.process_count() == 1:
             return self.preempt.requested
+        every = max(1, self.cfg.preempt_check_every)
+        if step % every != 0:
+            return False
         from jax.experimental import multihost_utils
 
         flags = multihost_utils.process_allgather(
