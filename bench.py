@@ -355,6 +355,8 @@ def bench_attention(args):
 
     on_tpu = jax.default_backend() == "tpu"
     heads, hd = 16, 128
+    if args.get("sweep"):
+        return _attention_block_sweep(args, heads, hd, on_tpu)
     rows = []
     for seq, batch in ((512, 16), (2048, 4), (8192, 1)):
         key = jax.random.key(seq)
@@ -417,11 +419,97 @@ def bench_attention(args):
     }
 
 
+def _attention_block_sweep(args, heads, hd, on_tpu):
+    """VERDICT r3 #6: block_q x block_k sweep for the flash kernel on the
+    real chip across seq {2k, 8k, 16k}; reports per-seq winners and the
+    hw-util ceiling found.  Run: ``python bench.py mode=attention
+    sweep=1`` (TPU only — interpreter-mode timings are meaningless)."""
+    import jax
+    import jax.numpy as jnp
+
+    from torch_automatic_distributed_neural_network_tpu.ops.flash_attention import (
+        flash_attention,
+    )
+    from torch_automatic_distributed_neural_network_tpu.training import (
+        peak_flops_per_chip,
+    )
+
+    if not on_tpu:
+        return {
+            "metric": "flash_block_sweep_unmeasurable",
+            "value": 0.0, "unit": "none", "vs_baseline": 0.0,
+            "extra": {"error": "sweep needs the real TPU backend"},
+        }
+    blocks = (256, 512, 1024, 2048)
+    rows = []
+    best = {}
+    for seq, batch in ((2048, 4), (8192, 1), (16384, 1)):
+        key = jax.random.key(seq)
+        kq, kk, kv = jax.random.split(key, 3)
+        shape = (batch, seq, heads, hd)
+        q = jax.random.normal(kq, shape, jnp.bfloat16)
+        k = jax.random.normal(kk, shape, jnp.bfloat16)
+        v = jax.random.normal(kv, shape, jnp.bfloat16)
+        flops = 0.5 * 12 * batch * heads * seq * seq * hd
+        for bq in blocks:
+            for bk in blocks:
+                if bq > seq or bk > seq:
+                    continue
+
+                def loss(q_, k_, v_):
+                    return jnp.sum(flash_attention(
+                        q_, k_, v_, causal=True, block_q=bq, block_k=bk,
+                    ).astype(jnp.float32))
+
+                try:
+                    grad = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+                    g = grad(q, k, v)  # compile (VMEM overflows raise)
+                    float(jnp.sum(g[0][0, 0, 0]))
+                except Exception as e:
+                    log(f"sweep seq={seq} bq={bq} bk={bk}: FAIL "
+                        f"{str(e)[:120]}")
+                    rows.append({"seq": seq, "block_q": bq, "block_k": bk,
+                                 "error": str(e)[:200]})
+                    continue
+                overhead = readback_overhead_s()
+                iters = 10 if seq <= 8192 else 5
+                t0 = time.perf_counter()
+                q_c = q
+                for _ in range(iters):
+                    g = grad(q_c, k, v)
+                    q_c = q_c + 0.0 * g[0]
+                float(jnp.sum(g[0][0, 0, 0]))
+                dt = max(time.perf_counter() - t0 - overhead, 1e-9) / iters
+                util = flops / dt / peak_flops_per_chip()
+                row = {"seq": seq, "block_q": bq, "block_k": bk,
+                       "ms": round(dt * 1e3, 3),
+                       "tflops": round(flops / dt / 1e12, 1),
+                       "hw_util": round(util, 4)}
+                rows.append(row)
+                log(f"sweep seq={seq} bq={bq} bk={bk}: {row['ms']}ms "
+                    f"{row['tflops']} TF/s util {util:.1%}")
+                cur = best.get(seq)
+                if cur is None or util > cur["hw_util"]:
+                    best[seq] = row
+    for seq, row in sorted(best.items()):
+        log(f"BEST seq={seq}: block_q={row['block_q']} "
+            f"block_k={row['block_k']} util {row['hw_util']:.1%}")
+    top8k = best.get(8192, {})
+    return {
+        "metric": "flash_block_sweep_best_util_seq8192",
+        "value": top8k.get("hw_util", 0.0),
+        "unit": "fraction_of_peak",
+        "vs_baseline": round(top8k.get("hw_util", 0.0) / 0.40, 4),
+        "extra": {"best": {str(k): v for k, v in best.items()},
+                  "rows": rows, "heads": heads, "head_dim": hd},
+    }
+
+
 # Simulated-device count each CPU-capable mode re-execs onto — ONE place
 # for both the per-mode guards and main()'s backend-down fallback.
 # memfit's entry is a default; it honors a devices= override in main().
 MODE_SIM_DEVICES = {"memfit": 64, "pipeline": 8, "overlap": 8,
-                    "collectives": 8}
+                    "collectives": 8, "decode": 8}
 
 
 def _cpu_sim_reexec(n_devices=8, note=""):
@@ -474,26 +562,64 @@ def bench_decode(args):
     )
 
     on_tpu = jax.default_backend() == "tpu"
-    if on_tpu:
-        size = args["model"] if args["model"] in (
-            "small", "medium") else "small"
-        prompt_len, new_tokens = 512, 256
+    moe = args["model"] == "moe"
+    gen_kwargs = {}
+    if moe:
+        # E=8 experts, expert-sharded (strategy='ep'), capacity-routed
+        # decode (moe_decode='routed', inference/decode.py r4) — the
+        # sharded-serving datapoint for VERDICT r3 weak #5
+        from torch_automatic_distributed_neural_network_tpu.models import (
+            MoE,
+            moe_config,
+        )
+        from torch_automatic_distributed_neural_network_tpu.training import (
+            moe_next_token_loss,
+        )
+
+        if jax.device_count() < 8 and not on_tpu:
+            _cpu_sim_reexec(MODE_SIM_DEVICES["decode"],
+                            "mode=decode model=moe: ep wants 8 devices")
+        size = "nano" if not on_tpu else "small"
+        prompt_len, new_tokens = (128, 32) if not on_tpu else (512, 256)
+        mcfg = moe_config(size, max_seq_len=prompt_len + new_tokens + 1)
+        strategy = "ep" if jax.device_count() >= 8 else "dp"
+        log(f"bench: decode MoE {size} E={mcfg.n_experts} "
+            f"({mcfg.num_params()/1e6:.0f}M total) routed strategy="
+            f"{strategy} prefill={prompt_len} decode={new_tokens}")
+        data = SyntheticLM(vocab_size=mcfg.vocab_size,
+                           seq_len=prompt_len + 1, batch_size=8)
+        ad = tad.AutoDistribute(
+            MoE(size, max_seq_len=prompt_len + new_tokens + 1),
+            # decode-only bench: sgd keeps init from materializing adamw
+            # moments generate() never reads (2x params fp32 on the 16
+            # GiB chip for the ~0.9B 'small' MoE)
+            optimizer=optax.sgd(1e-4),
+            loss_fn=moe_next_token_loss,
+            strategy=strategy,
+        )
+        gen_kwargs = {"moe_decode": "routed"}
+        size = f"moe_{size}"
     else:
-        # CPU sim: the 124M model's 256-step decode scan grinds for tens
-        # of minutes — smoke-test the machinery at test scale instead.
-        size, prompt_len, new_tokens = "test", 128, 64
-        log("mode=decode: CPU sim -> model=test prefill=128 decode=64")
-    mcfg = gpt2_config(size, max_seq_len=prompt_len + new_tokens + 1)
-    log(f"bench: decode GPT-2 {size} ({mcfg.num_params()/1e6:.0f}M) "
-        f"prefill={prompt_len} decode={new_tokens}")
-    data = SyntheticLM(vocab_size=mcfg.vocab_size, seq_len=prompt_len + 1,
-                       batch_size=8)
-    ad = tad.AutoDistribute(
-        GPT2(size, max_seq_len=prompt_len + new_tokens + 1),
-        optimizer=optax.adamw(1e-4),
-        loss_fn=next_token_loss,
-        strategy="dp",
-    )
+        if on_tpu:
+            size = args["model"] if args["model"] in (
+                "small", "medium") else "small"
+            prompt_len, new_tokens = 512, 256
+        else:
+            # CPU sim: the 124M model's 256-step decode scan grinds for
+            # tens of minutes — smoke-test at test scale instead.
+            size, prompt_len, new_tokens = "test", 128, 64
+            log("mode=decode: CPU sim -> model=test prefill=128 decode=64")
+        mcfg = gpt2_config(size, max_seq_len=prompt_len + new_tokens + 1)
+        log(f"bench: decode GPT-2 {size} ({mcfg.num_params()/1e6:.0f}M) "
+            f"prefill={prompt_len} decode={new_tokens}")
+        data = SyntheticLM(vocab_size=mcfg.vocab_size,
+                           seq_len=prompt_len + 1, batch_size=8)
+        ad = tad.AutoDistribute(
+            GPT2(size, max_seq_len=prompt_len + new_tokens + 1),
+            optimizer=optax.adamw(1e-4),
+            loss_fn=next_token_loss,
+            strategy="dp",
+        )
     state = ad.init(jax.random.key(0), data.batch(0))
 
     rows = []
@@ -502,12 +628,14 @@ def bench_decode(args):
         prompt = jax.numpy.asarray(prompt, dtype=jax.numpy.int32)
 
         def timed_generate(n_new, iters=3):
-            out = ad.generate(state, prompt, max_new_tokens=n_new)
+            out = ad.generate(state, prompt, max_new_tokens=n_new,
+                              **gen_kwargs)
             np.asarray(out)  # warm: trace + compile + run (host readback fence)
             overhead = readback_overhead_s()
             t0 = time.perf_counter()
             for _ in range(iters):
-                out = ad.generate(state, prompt, max_new_tokens=n_new)
+                out = ad.generate(state, prompt, max_new_tokens=n_new,
+                                  **gen_kwargs)
             np.asarray(out)  # ONE fence for the whole chain
             # overhead is one readback per MEASUREMENT, not per iteration
             return max(
@@ -531,13 +659,16 @@ def bench_decode(args):
             f"({t_decode*1e3/new_tokens:.1f}ms/tok)")
 
     return {
-        "metric": f"gpt2_{size}_decode_tokens_per_sec_batch8",
+        "metric": (f"{size}_decode_tokens_per_sec_batch8" if moe
+                   else f"gpt2_{size}_decode_tokens_per_sec_batch8"),
         "value": rows[-1]["decode_tokens_per_s"],
         "unit": "tokens/s",
         "vs_baseline": 0.0,
         "extra": {"rows": rows, "prompt_len": prompt_len,
                   "new_tokens": new_tokens, "params_m":
                   round(mcfg.num_params() / 1e6),
+                  "strategy": ad.plan.strategy if ad.plan else None,
+                  **({"moe_decode": "routed"} if moe else {}),
                   "backend": jax.default_backend()},
     }
 

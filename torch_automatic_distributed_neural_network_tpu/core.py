@@ -837,6 +837,7 @@ class AutoDistribute:
         rng: jax.Array | None = None,
         cache_dtype=jnp.bfloat16,
         eos_id: int | None = None,
+        moe_decode: str = "dense",
     ):
         """Plan-aware autoregressive generation (inference/decode.py).
 
@@ -860,7 +861,7 @@ class AutoDistribute:
             rng = jax.random.key(0)
         mesh = self.plan.mesh
         key = (max_new_tokens, sample, str(jnp.dtype(cache_dtype)),
-               eos_id, tuple(getattr(prompt, "shape", ())))
+               eos_id, moe_decode, tuple(getattr(prompt, "shape", ())))
         cached = getattr(self, "_generate_cache", None)
         if cached is None:
             cached = self._generate_cache = {}
@@ -870,6 +871,7 @@ class AutoDistribute:
                     self.model, {"params": params}, prompt,
                     max_new_tokens=max_new_tokens, sample=sample, rng=rng,
                     cache_dtype=cache_dtype, mesh=mesh, eos_id=eos_id,
+                    moe_decode=moe_decode,
                 )
 
             # Small decode batches (e.g. batch 1 on an 8-device mesh)
