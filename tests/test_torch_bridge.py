@@ -359,3 +359,188 @@ def test_autodistribute_trains_bridged_cnn(devices8):
         losses.append(float(metrics["loss"]))
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0]
+
+
+class TestTorchTransformerFamily:
+    """nn.MultiheadAttention + the nn.Transformer composites convert as
+    leaves (their forwards are not fx-traceable); parity vs torch CPU.
+    This is the reference's MT-example class (SURVEY.md C12) running
+    unmodified."""
+
+    def test_mha_masks_and_cross_attention(self):
+        torch.manual_seed(20)
+        mha = tnn.MultiheadAttention(32, 4, batch_first=True).eval()
+
+        class Wrap(tnn.Module):
+            def __init__(self):
+                super().__init__()
+                self.mha = mha
+
+            def forward(self, q, k, v, m, kpm):
+                return self.mha(q, k, v, attn_mask=m,
+                                key_padding_mask=kpm)[0]
+
+        model, variables = from_torch(Wrap())
+        rs = np.random.RandomState(20)
+        q = rs.randn(2, 5, 32).astype(np.float32)
+        k = rs.randn(2, 9, 32).astype(np.float32)  # cross: Tk != Tq
+        v = rs.randn(2, 9, 32).astype(np.float32)
+        m = rs.rand(5, 9) > 0.7           # bool: True = NOT allowed
+        kpm = np.zeros((2, 9), bool)
+        kpm[1, 6:] = True                 # padding on row 1
+        with torch.no_grad():
+            ref = mha(torch.tensor(q), torch.tensor(k), torch.tensor(v),
+                      attn_mask=torch.tensor(m),
+                      key_padding_mask=torch.tensor(kpm))[0].numpy()
+        got = model.apply(variables, *(jnp.asarray(a)
+                                       for a in (q, k, v, m, kpm)))
+        np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-5,
+                                   atol=2e-5)
+
+    @pytest.mark.parametrize("norm_first", [False, True])
+    @pytest.mark.parametrize("batch_first", [True, False])
+    def test_transformer_encoder_stack(self, norm_first, batch_first):
+        torch.manual_seed(21)
+        enc = tnn.TransformerEncoder(
+            tnn.TransformerEncoderLayer(
+                32, 4, 64, dropout=0.0, batch_first=batch_first,
+                norm_first=norm_first, activation="gelu"),
+            num_layers=2).eval()
+
+        class Wrap(tnn.Module):
+            def __init__(self):
+                super().__init__()
+                self.enc = enc
+
+            def forward(self, x):
+                return self.enc(x)
+
+        model, variables = from_torch(Wrap())
+        shape = (2, 7, 32) if batch_first else (7, 2, 32)
+        x = np.random.RandomState(21).randn(*shape).astype(np.float32)
+        with torch.no_grad():
+            ref = enc(torch.tensor(x)).numpy()
+        got = model.apply(variables, jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-5,
+                                   atol=2e-5)
+
+    def _mt_model(self, seq=10):
+        torch.manual_seed(22)
+
+        class MT(tnn.Module):
+            def __init__(self, vocab=50, d=32):
+                super().__init__()
+                self.src_emb = tnn.Embedding(vocab, d)
+                self.tgt_emb = tnn.Embedding(vocab, d)
+                self.tf = tnn.Transformer(d, 4, 2, 2, 64, dropout=0.0,
+                                          batch_first=True)
+                self.out = tnn.Linear(d, vocab)
+                self.register_buffer(
+                    "tgt_mask",
+                    tnn.Transformer.generate_square_subsequent_mask(seq))
+
+            def forward(self, src, tgt):
+                t = tgt.size(1)
+                y = self.tf(self.src_emb(src), self.tgt_emb(tgt),
+                            tgt_mask=self.tgt_mask[:t, :t])
+                return self.out(y)
+
+        return MT().eval()
+
+    def test_full_nn_transformer_mt_logits_and_grads(self):
+        net = self._mt_model()
+        model, variables = from_torch(net)
+        rs = np.random.RandomState(22)
+        src = rs.randint(0, 50, (2, 9))
+        tgt = rs.randint(0, 50, (2, 7))
+        tloss = net(torch.tensor(src), torch.tensor(tgt)).pow(2).mean()
+        ref = net(torch.tensor(src), torch.tensor(tgt)).detach().numpy()
+        tgrads = _torch_grads(net, tloss)
+
+        got = model.apply(variables, jnp.asarray(src), jnp.asarray(tgt))
+        np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-5,
+                                   atol=2e-5)
+
+        def jloss(params):
+            out = model.apply(
+                {"params": params, "constants": variables["constants"]},
+                jnp.asarray(src), jnp.asarray(tgt))
+            return (out ** 2).mean()
+
+        jgrads = jax.grad(jloss)(variables["params"])
+        _check_grads(jgrads, tgrads, {
+            "src_emb//embedding": ("src_emb.weight", lambda w: w),
+            "out//kernel": ("out.weight", lambda w: w.T),
+            "tf//enc.l0.sa.in_w": (
+                "tf.encoder.layers.0.self_attn.in_proj_weight",
+                lambda w: w),
+            "tf//dec.l1.ca.out_w": (
+                "tf.decoder.layers.1.multihead_attn.out_proj.weight",
+                lambda w: w),
+            "tf//dec.l0.lin1.kernel": (
+                "tf.decoder.layers.0.linear1.weight", lambda w: w.T),
+            "tf//enc.norm.scale": ("tf.encoder.norm.weight",
+                                   lambda w: w),
+        })
+
+    def test_mt_trains_under_autodistribute(self, devices8):
+        import optax
+
+        from torch_automatic_distributed_neural_network_tpu import (
+            AutoDistribute,
+        )
+
+        net = self._mt_model()
+        model, variables = from_torch(net)
+        rs = np.random.RandomState(23)
+        batch = {"src": rs.randint(0, 50, (16, 9)),
+                 "tgt": rs.randint(0, 50, (16, 8))}
+
+        def loss_fn(params, model_state, batch, rng, apply_fn):
+            import optax as _optax
+
+            vs = {"params": params, **model_state}
+            logits, _ = apply_fn(
+                vs, batch["src"], batch["tgt"][:, :-1],
+                mutable=list(model_state.keys()))
+            return _optax.softmax_cross_entropy_with_integer_labels(
+                logits, batch["tgt"][:, 1:]).mean(), {}
+
+        ad = AutoDistribute(
+            model, optimizer=optax.sgd(0.1), loss_fn=loss_fn,
+            strategy="dp", devices=jax.devices(),
+            init_fn=lambda rng, b: variables,
+        )
+        state = ad.init(jax.random.key(0), batch)
+        losses = []
+        for _ in range(4):
+            state, m = ad.step(state, batch)
+            losses.append(float(m["loss"]))
+        assert np.all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+    def test_mha_positional_key_padding_mask(self):
+        """torch's forward positional order is (q, k, v,
+        key_padding_mask, need_weights, attn_mask) — a positional kpm
+        call must not be consumed as attn_mask (review r4)."""
+        torch.manual_seed(24)
+        mha = tnn.MultiheadAttention(16, 2, batch_first=True).eval()
+
+        class Wrap(tnn.Module):
+            def __init__(self):
+                super().__init__()
+                self.mha = mha
+
+            def forward(self, q, kpm):
+                return self.mha(q, q, q, kpm)[0]
+
+        model, variables = from_torch(Wrap())
+        rs = np.random.RandomState(24)
+        q = rs.randn(3, 5, 16).astype(np.float32)
+        kpm = np.zeros((3, 5), bool)
+        kpm[0, 3:] = True
+        with torch.no_grad():
+            ref = mha(torch.tensor(q), torch.tensor(q), torch.tensor(q),
+                      torch.tensor(kpm))[0].numpy()
+        got = model.apply(variables, jnp.asarray(q), jnp.asarray(kpm))
+        np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-5,
+                                   atol=2e-5)

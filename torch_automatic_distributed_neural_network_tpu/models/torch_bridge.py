@@ -24,7 +24,14 @@ a linen module attribute) plus a converted parameter pytree:
   (matmul/softmax/permute/view/masked_fill/tril/... — enough for a
   hand-written attention block);
 - **get_attr** tensors become trainable params (``requires_grad``) or
-  ``constants`` collection entries (buffers).
+  ``constants`` collection entries (buffers);
+- **torch's own composites** — ``nn.MultiheadAttention`` and the whole
+  ``nn.Transformer`` family (Encoder/Decoder layers and stacks,
+  ``nn.Transformer`` itself) — convert as leaves with hand-written
+  executors (their forwards carry fast-path control flow fx cannot
+  trace), so a stock torch MT transformer runs unmodified.  Unlike
+  fx's default tracer, OTHER torch.nn composites are traced through to
+  their convertible leaves rather than rejected.
 
 Anything outside the table raises ``UnsupportedTorchModule`` naming the
 exact node, rather than silently mistranslating.  Models with
@@ -99,6 +106,82 @@ def _np(t):
     return np.asarray(t.detach().cpu().numpy())
 
 
+def _mha_leaf_params(mha, prefix: str) -> dict:
+    if not mha._qkv_same_embed_dim:
+        raise UnsupportedTorchModule(
+            "MultiheadAttention with kdim/vdim != embed_dim")
+    if mha.bias_k is not None or mha.add_zero_attn:
+        raise UnsupportedTorchModule("MHA bias_k / add_zero_attn")
+    p = {prefix + "in_w": _np(mha.in_proj_weight),     # [3d, d]
+         prefix + "out_w": _np(mha.out_proj.weight)}   # [d, d]
+    if mha.in_proj_bias is not None:
+        p[prefix + "in_b"] = _np(mha.in_proj_bias)
+    if mha.out_proj.bias is not None:
+        p[prefix + "out_b"] = _np(mha.out_proj.bias)
+    return p
+
+
+def _act_name(fn) -> str:
+    name = getattr(fn, "__name__", str(fn))
+    if "gelu" in name:
+        return "gelu"
+    if "relu" in name:
+        return "relu"
+    raise UnsupportedTorchModule(f"transformer activation {name!r}")
+
+
+def _tel_params_cfg(layer, prefix: str = "", cross: bool = False):
+    """TransformerEncoder/DecoderLayer -> (flat params, cfg).  Norms are
+    numbered in torch's order: norm1 (self-attn), [norm2 cross-attn,]
+    last norm (FFN)."""
+    p = {}
+    p.update(_mha_leaf_params(layer.self_attn, prefix + "sa."))
+    if cross:
+        p.update(_mha_leaf_params(layer.multihead_attn, prefix + "ca."))
+    for lin, name in ((layer.linear1, "lin1."), (layer.linear2, "lin2.")):
+        p[prefix + name + "kernel"] = _np(lin.weight).T
+        if lin.bias is not None:
+            p[prefix + name + "bias"] = _np(lin.bias)
+    for n in ("norm1", "norm2") + (("norm3",) if cross else ()):
+        ln = getattr(layer, n)
+        if ln.weight is None or ln.bias is None:
+            raise UnsupportedTorchModule(
+                "transformer layer norm without affine weight+bias "
+                "(bias=False / elementwise_affine=False)")
+        p[prefix + n + ".scale"] = _np(ln.weight)
+        p[prefix + n + ".bias"] = _np(ln.bias)
+    cfg = {"heads": int(layer.self_attn.num_heads),
+           "batch_first": bool(layer.self_attn.batch_first),
+           "norm_first": bool(layer.norm_first),
+           "act": _act_name(layer.activation),
+           "rate": float(layer.dropout1.p),
+           "attn_rate": float(layer.self_attn.dropout),
+           "eps": float(layer.norm1.eps)}
+    return p, cfg
+
+
+def _tstack_params_cfg(layers, final_norm, prefix: str,
+                       cross: bool = False):
+    p, cfg = {}, None
+    for i, layer in enumerate(layers):
+        pi, cfg_i = _tel_params_cfg(layer, prefix=f"{prefix}l{i}.",
+                                    cross=cross)
+        p.update(pi)
+        if cfg is not None and cfg_i != cfg:
+            raise UnsupportedTorchModule(
+                "transformer stack with heterogeneous layer configs")
+        cfg = cfg_i
+    if final_norm is not None:
+        if getattr(final_norm, "weight", None) is None or \
+                getattr(final_norm, "bias", None) is None:
+            raise UnsupportedTorchModule(
+                "transformer stack final norm without affine "
+                "weight+bias")
+        p[prefix + "norm.scale"] = _np(final_norm.weight)
+        p[prefix + "norm.bias"] = _np(final_norm.bias)
+    return p, dict(cfg)
+
+
 def _convert_leaf(mod) -> tuple[str, dict, dict, dict]:
     import torch.nn as tnn
 
@@ -169,6 +252,50 @@ def _convert_leaf(mod) -> tuple[str, dict, dict, dict]:
                       "padding": _pair(mod.padding)}, {}, {}
     if isinstance(mod, tnn.AdaptiveAvgPool2d):
         return "adaptiveavgpool2d", {"out": _pair(mod.output_size)}, {}, {}
+    if isinstance(mod, tnn.GroupNorm):
+        p = {}
+        if mod.affine:
+            p = {"scale": _np(mod.weight), "bias": _np(mod.bias)}
+        return "groupnorm", {"groups": int(mod.num_groups),
+                             "eps": float(mod.eps),
+                             "affine": bool(mod.affine)}, p, {}
+    if isinstance(mod, tnn.MultiheadAttention):
+        p = _mha_leaf_params(mod, "")
+        cfg = {"heads": int(mod.num_heads),
+               "batch_first": bool(mod.batch_first),
+               "rate": float(mod.dropout)}
+        return "mha", cfg, p, {}
+    if isinstance(mod, tnn.TransformerEncoderLayer):
+        p, cfg = _tel_params_cfg(mod)
+        return "tel", cfg, p, {}
+    if isinstance(mod, tnn.TransformerDecoderLayer):
+        p, cfg = _tel_params_cfg(mod, cross=True)
+        return "tdl", cfg, p, {}
+    if isinstance(mod, tnn.TransformerEncoder):
+        p, cfg = _tstack_params_cfg(mod.layers, mod.norm, "")
+        cfg.update(kind="encoder", n_layers=len(mod.layers))
+        return "tstack", cfg, p, {}
+    if isinstance(mod, tnn.TransformerDecoder):
+        p, cfg = _tstack_params_cfg(mod.layers, mod.norm, "", cross=True)
+        cfg.update(kind="decoder", n_layers=len(mod.layers))
+        return "tstack", cfg, p, {}
+    if isinstance(mod, tnn.Transformer):
+        p, cfg = _tstack_params_cfg(
+            mod.encoder.layers, mod.encoder.norm, "enc.")
+        pd, cfg_d = _tstack_params_cfg(
+            mod.decoder.layers, mod.decoder.norm, "dec.", cross=True)
+        if cfg_d != cfg:
+            # _apply_tstack runs both stacks with ONE cfg; a custom
+            # encoder/decoder pair with different heads/act/norm wiring
+            # would silently mistranslate
+            raise UnsupportedTorchModule(
+                "nn.Transformer with differing encoder/decoder layer "
+                f"configs: {cfg} vs {cfg_d}")
+        p.update(pd)
+        cfg.update(kind="transformer",
+                   enc_layers=len(mod.encoder.layers),
+                   dec_layers=len(mod.decoder.layers))
+        return "tstack", cfg, p, {}
     if isinstance(mod, tnn.Identity):
         return "identity", {}, {}, {}
     acts = {tnn.ReLU: "relu", tnn.GELU: "gelu", tnn.SiLU: "silu",
@@ -186,9 +313,29 @@ def _convert_leaf(mod) -> tuple[str, dict, dict, dict]:
             return kind, cfg, {}, {}
     raise UnsupportedTorchModule(
         f"no converter for torch module {type(mod).__name__}; supported: "
-        "Linear Conv2d BatchNorm1d/2d LayerNorm Embedding Dropout Flatten "
-        "MaxPool2d AvgPool2d AdaptiveAvgPool2d Identity and common "
-        "activations"
+        "Linear Conv2d BatchNorm1d/2d LayerNorm GroupNorm Embedding "
+        "MultiheadAttention Dropout Flatten MaxPool2d AvgPool2d "
+        "AdaptiveAvgPool2d Identity and common activations"
+    )
+
+
+def _leaf_types():
+    """Module types converted as leaves.  Everything else — containers,
+    torch.nn composites (TransformerEncoderLayer, TransformerDecoder,
+    nn.Transformer itself), user modules — is traced THROUGH, so stock
+    torch transformer stacks decompose into these leaves."""
+    import torch.nn as tnn
+
+    return (
+        tnn.Linear, tnn.Conv2d, tnn.BatchNorm1d, tnn.BatchNorm2d,
+        tnn.LayerNorm, tnn.GroupNorm, tnn.Embedding,
+        tnn.MultiheadAttention, tnn.TransformerEncoderLayer,
+        tnn.TransformerDecoderLayer, tnn.TransformerEncoder,
+        tnn.TransformerDecoder, tnn.Transformer,
+        tnn.Dropout, tnn.Flatten, tnn.MaxPool2d,
+        tnn.AvgPool2d, tnn.AdaptiveAvgPool2d, tnn.Identity, tnn.ReLU,
+        tnn.GELU, tnn.SiLU, tnn.Tanh, tnn.Sigmoid, tnn.LeakyReLU,
+        tnn.Softmax,
     )
 
 
@@ -348,6 +495,11 @@ def _function_table():
         torch.zeros: lambda *s, dtype=None, device=None: jnp.zeros(
             s[0] if len(s) == 1 and isinstance(s[0], (tuple, list)) else s),
         torch.arange: lambda *a, dtype=None, device=None: jnp.arange(*a),
+        torch.full: lambda size, fill, dtype=None, device=None: jnp.full(
+            tuple(size), fill),
+        torch.logical_and: jnp.logical_and,
+        torch.logical_or: jnp.logical_or,
+        torch.logical_not: jnp.logical_not,
         torch.unsqueeze: lambda x, dim: jnp.expand_dims(x, dim),
         torch.squeeze: lambda x, dim=None: jnp.squeeze(x, dim),
         F.relu: lambda x, inplace=False: jax.nn.relu(x),
@@ -487,9 +639,12 @@ class TorchBridge(nn.Module):
                 else:
                     env[node.name] = self._p(scope, "value")
             elif node.kind == "call_module":
-                x = _thaw(node.args[0], env)
+                largs = tuple(_thaw(a, env) for a in node.args)
+                lkwargs = {k: _thaw(v, env) for k, v in node.kwargs
+                           if k != "__scope__"}
                 env[node.name] = self._apply_layer(
-                    node, x, train, param_shapes, stat_shapes)
+                    node, largs, lkwargs, train, param_shapes,
+                    stat_shapes)
             elif node.kind == "call_function":
                 impl = fn_table.get(node.target)
                 if impl is None:
@@ -510,13 +665,50 @@ class TorchBridge(nn.Module):
                 raise UnsupportedTorchModule(f"node kind {node.kind}")
         return out
 
-    def _apply_layer(self, node, x, train, param_shapes, stat_shapes):
+    def _apply_layer(self, node, largs, lkwargs, train, param_shapes,
+                     stat_shapes):
         kind = node.target
         cfg = dict(node.cfg)
         scope = _sanitize(dict(node.kwargs)["__scope__"][1])
+        x = largs[0] if largs else None
 
         def names():
             return [n for n, _ in param_shapes.get(scope, ())]
+
+        if kind == "mha":
+            return self._apply_mha(scope, cfg, largs, lkwargs, train,
+                                   names())
+        if kind in ("tel", "tdl"):
+            bf = cfg["batch_first"]
+
+            def arg(i, *keys):
+                for key in keys:
+                    if key in lkwargs:
+                        return lkwargs[key]
+                return largs[i] if len(largs) > i else None
+
+            x0 = largs[0]
+            if kind == "tel":
+                mem, mm, mkpm = None, None, None
+                mask = arg(1, "src_mask")
+                kpm = arg(2, "src_key_padding_mask")
+            else:
+                mem = arg(1, "memory")
+                mask = arg(2, "tgt_mask")
+                mm = arg(3, "memory_mask")
+                kpm = arg(4, "tgt_key_padding_mask")
+                mkpm = arg(5, "memory_key_padding_mask")
+            if not bf:
+                x0 = jnp.swapaxes(x0, 0, 1)
+                mem = None if mem is None else jnp.swapaxes(mem, 0, 1)
+            y = self._apply_tel(
+                scope, cfg, x0, names(), train, attn_mask=mask,
+                key_padding_mask=kpm, memory=mem, memory_mask=mm,
+                memory_key_padding_mask=mkpm)
+            return y if bf else jnp.swapaxes(y, 0, 1)
+        if kind == "tstack":
+            return self._apply_tstack(scope, cfg, largs, lkwargs, train,
+                                      names())
 
         if kind == "linear":
             y = x @ self._p(scope, "kernel")
@@ -561,6 +753,20 @@ class TorchBridge(nn.Module):
             if cfg["affine"]:
                 y = y * self._p(scope, "scale") + self._p(scope, "bias")
             return y
+        if kind == "groupnorm":
+            g = cfg["groups"]
+            b_, c = x.shape[0], x.shape[1]
+            xg = x.reshape((b_, g, c // g) + tuple(x.shape[2:]))
+            axes = tuple(range(2, xg.ndim))
+            mean = xg.mean(axes, keepdims=True)
+            var = xg.var(axes, keepdims=True)
+            y = ((xg - mean) * jax.lax.rsqrt(var + cfg["eps"])).reshape(
+                x.shape)
+            if cfg["affine"]:
+                shape = (1, c) + (1,) * (x.ndim - 2)
+                y = y * self._p(scope, "scale").reshape(shape) \
+                    + self._p(scope, "bias").reshape(shape)
+            return y
         if kind == "embedding":
             return self._p(scope, "embedding")[x]
         if kind == "dropout":
@@ -597,6 +803,235 @@ class TorchBridge(nn.Module):
         if kind == "softmax":
             return _t_softmax(x, cfg["dim"])
         raise UnsupportedTorchModule(f"layer kind {kind}")
+
+    def _apply_mha(self, scope, cfg, largs, lkwargs, train, names):
+        """nn.MultiheadAttention, torch semantics: packed in_proj,
+        bool masks mean NOT-allowed (key_padding_mask True = ignore),
+        float masks are additive, returns (output, attn_weights)."""
+        q, k, v = largs[0], largs[1], largs[2]
+
+        def arg(i, key, default=None):
+            # torch forward positional order: (query, key, value,
+            # key_padding_mask, need_weights, attn_mask,
+            # average_attn_weights, is_causal)
+            if key in lkwargs:
+                return lkwargs[key]
+            return largs[i] if len(largs) > i else default
+
+        key_padding_mask = arg(3, "key_padding_mask")
+        need_weights = arg(4, "need_weights", True)
+        attn_mask = arg(5, "attn_mask")
+        average_attn_weights = arg(6, "average_attn_weights", True)
+        is_causal = arg(7, "is_causal", False)
+        if not cfg["batch_first"]:  # torch default layout [T, B, d]
+            q, k, v = (jnp.swapaxes(t, 0, 1) for t in (q, k, v))
+        out, w = self._mha_core(
+            scope, "", cfg, q, k, v,
+            attn_mask=attn_mask,
+            key_padding_mask=key_padding_mask,
+            is_causal=is_causal,
+            train=train, names=names,
+        )
+        if not cfg["batch_first"]:
+            out = jnp.swapaxes(out, 0, 1)
+        if need_weights:
+            if average_attn_weights:
+                w = w.mean(axis=1)
+            return (out, w)
+        return (out, None)
+
+    def _mha_core(self, scope, prefix, cfg, q, k, v, *, attn_mask,
+                  key_padding_mask, is_causal, train, names):
+        """Batch-first multi-head attention math shared by the MHA leaf
+        and the nn.Transformer-family composite executors.  Params are
+        read as ``{prefix}in_w`` etc. under ``scope``.  Returns
+        ``(out [B,Tq,d], probs [B,H,Tq,Tk])``."""
+        H = cfg["heads"]
+        d = q.shape[-1]
+        in_w = self._p(scope, prefix + "in_w")  # [3d, d], torch layout
+        in_b = (self._p(scope, prefix + "in_b")
+                if prefix + "in_b" in names else None)
+
+        def proj(x, lo):
+            y = x @ in_w[lo:lo + d].T
+            return y if in_b is None else y + in_b[lo:lo + d]
+
+        qp, kp, vp = proj(q, 0), proj(k, d), proj(v, 2 * d)
+        B, Tq = qp.shape[0], qp.shape[1]
+        Tk = kp.shape[1]
+        hd = d // H
+        qh = qp.reshape(B, Tq, H, hd).transpose(0, 2, 1, 3)
+        kh = kp.reshape(B, Tk, H, hd).transpose(0, 2, 1, 3)
+        vh = vp.reshape(B, Tk, H, hd).transpose(0, 2, 1, 3)
+        scores = (qh @ kh.transpose(0, 1, 3, 2)) / jnp.sqrt(
+            jnp.asarray(hd, qh.dtype))  # [B, H, Tq, Tk]
+        neg = jnp.finfo(scores.dtype).min * 0.5
+        if is_causal:
+            causal = jnp.tril(jnp.ones((Tq, Tk), bool), k=Tk - Tq)
+            scores = jnp.where(causal[None, None], scores, neg)
+        if attn_mask is not None:
+            m = attn_mask
+            if m.ndim == 3:  # [B*H, Tq, Tk]
+                m = m.reshape(B, H, Tq, Tk)
+            else:  # [Tq, Tk]
+                m = m[None, None]
+            if m.dtype == jnp.bool_:
+                scores = jnp.where(m, neg, scores)  # True = NOT allowed
+            else:
+                scores = scores + m.astype(scores.dtype)
+        if key_padding_mask is not None:  # [B, Tk] True = ignore
+            scores = jnp.where(
+                key_padding_mask[:, None, None, :], neg, scores)
+        probs = jax.nn.softmax(scores, axis=-1)
+        probs = self._drop(probs, cfg.get("attn_rate", cfg["rate"]),
+                           train)
+        out = (probs @ vh).transpose(0, 2, 1, 3).reshape(B, Tq, d)
+        out = out @ self._p(scope, prefix + "out_w").T
+        if prefix + "out_b" in names:
+            out = out + self._p(scope, prefix + "out_b")
+        return out, probs
+
+    def _drop(self, x, rate, train):
+        if not train or rate <= 0.0:
+            return x
+        keep = 1.0 - rate
+        dm = jax.random.bernoulli(self.make_rng("dropout"), keep, x.shape)
+        return jnp.where(dm, x / keep, 0.0)
+
+    def _lin(self, scope, prefix, x, names):
+        y = x @ self._p(scope, prefix + "kernel")
+        if prefix + "bias" in names:
+            y = y + self._p(scope, prefix + "bias")
+        return y
+
+    def _ln(self, scope, prefix, x, eps):
+        mean = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        y = (x - mean) * jax.lax.rsqrt(var + eps)
+        return y * self._p(scope, prefix + "scale") \
+            + self._p(scope, prefix + "bias")
+
+    def _apply_tel(self, scope, cfg, x, names, train, *, prefix="",
+                   attn_mask=None, key_padding_mask=None, memory=None,
+                   memory_mask=None, memory_key_padding_mask=None):
+        """One TransformerEncoderLayer (or, with ``memory``, a
+        TransformerDecoderLayer): torch's post-/pre-norm residual
+        wiring around `_mha_core` + the FFN."""
+        eps = cfg["eps"]
+        act = _t_gelu if cfg["act"] == "gelu" else jax.nn.relu
+        rate = cfg["rate"]
+
+        def sa(h):
+            out, _ = self._mha_core(
+                scope, prefix + "sa.", cfg, h, h, h,
+                attn_mask=attn_mask, key_padding_mask=key_padding_mask,
+                is_causal=False, train=train, names=names)
+            return self._drop(out, rate, train)
+
+        def ca(h):
+            out, _ = self._mha_core(
+                scope, prefix + "ca.", cfg, h, memory, memory,
+                attn_mask=memory_mask,
+                key_padding_mask=memory_key_padding_mask,
+                is_causal=False, train=train, names=names)
+            return self._drop(out, rate, train)
+
+        def ff(h):
+            h = act(self._lin(scope, prefix + "lin1.", h, names))
+            h = self._drop(h, rate, train)
+            h = self._lin(scope, prefix + "lin2.", h, names)
+            return self._drop(h, rate, train)
+
+        n = 1
+        if cfg["norm_first"]:
+            x = x + sa(self._ln(scope, f"{prefix}norm{n}.", x, eps))
+            n += 1
+            if memory is not None:
+                x = x + ca(self._ln(scope, f"{prefix}norm{n}.", x, eps))
+                n += 1
+            x = x + ff(self._ln(scope, f"{prefix}norm{n}.", x, eps))
+        else:
+            x = self._ln(scope, f"{prefix}norm{n}.", x + sa(x), eps)
+            n += 1
+            if memory is not None:
+                x = self._ln(scope, f"{prefix}norm{n}.", x + ca(x), eps)
+                n += 1
+            x = self._ln(scope, f"{prefix}norm{n}.", x + ff(x), eps)
+        return x
+
+    def _apply_tstack(self, scope, cfg, largs, lkwargs, train, names):
+        """TransformerEncoder / TransformerDecoder / nn.Transformer,
+        executed from converted per-layer params (torch's forwards are
+        not fx-traceable — fast-path control flow on input properties —
+        so the composites are converted as leaves instead)."""
+        kind = cfg["kind"]
+        bf = cfg["batch_first"]
+
+        def get(i, *ks, default=None):
+            for k in ks:
+                if k in lkwargs:
+                    return lkwargs[k]
+            return largs[i] if len(largs) > i else default
+
+        if kind == "transformer":
+            src, tgt = largs[0], largs[1]
+            src_mask = get(2, "src_mask")
+            tgt_mask = get(3, "tgt_mask")
+            memory_mask = get(4, "memory_mask")
+            src_kpm = get(5, "src_key_padding_mask")
+            tgt_kpm = get(6, "tgt_key_padding_mask")
+            mem_kpm = get(7, "memory_key_padding_mask")
+            if not bf:
+                src, tgt = jnp.swapaxes(src, 0, 1), jnp.swapaxes(tgt, 0, 1)
+            mem = src
+            for i in range(cfg["enc_layers"]):
+                mem = self._apply_tel(
+                    scope, cfg, mem, names, train, prefix=f"enc.l{i}.",
+                    attn_mask=src_mask, key_padding_mask=src_kpm)
+            if "enc.norm.scale" in names:
+                mem = self._ln(scope, "enc.norm.", mem, cfg["eps"])
+            x = tgt
+            for i in range(cfg["dec_layers"]):
+                x = self._apply_tel(
+                    scope, cfg, x, names, train, prefix=f"dec.l{i}.",
+                    attn_mask=tgt_mask, key_padding_mask=tgt_kpm,
+                    memory=mem, memory_mask=memory_mask,
+                    memory_key_padding_mask=mem_kpm)
+            if "dec.norm.scale" in names:
+                x = self._ln(scope, "dec.norm.", x, cfg["eps"])
+            return x if bf else jnp.swapaxes(x, 0, 1)
+
+        if kind == "encoder":
+            x = largs[0]
+            mask = get(1, "mask", "src_mask")
+            kpm = get(2, "src_key_padding_mask")
+            if not bf:
+                x = jnp.swapaxes(x, 0, 1)
+            for i in range(cfg["n_layers"]):
+                x = self._apply_tel(
+                    scope, cfg, x, names, train, prefix=f"l{i}.",
+                    attn_mask=mask, key_padding_mask=kpm)
+            if "norm.scale" in names:
+                x = self._ln(scope, "norm.", x, cfg["eps"])
+            return x if bf else jnp.swapaxes(x, 0, 1)
+
+        # decoder
+        x, mem = largs[0], largs[1]
+        tgt_mask = get(2, "tgt_mask")
+        memory_mask = get(3, "memory_mask")
+        tgt_kpm = get(4, "tgt_key_padding_mask")
+        mem_kpm = get(5, "memory_key_padding_mask")
+        if not bf:
+            x, mem = jnp.swapaxes(x, 0, 1), jnp.swapaxes(mem, 0, 1)
+        for i in range(cfg["n_layers"]):
+            x = self._apply_tel(
+                scope, cfg, x, names, train, prefix=f"l{i}.",
+                attn_mask=tgt_mask, key_padding_mask=tgt_kpm,
+                memory=mem, memory_mask=memory_mask,
+                memory_key_padding_mask=mem_kpm)
+        if "norm.scale" in names:
+            x = self._ln(scope, "norm.", x, cfg["eps"])
+        return x if bf else jnp.swapaxes(x, 0, 1)
 
 
 # ---------------------------------------------------------------------------
@@ -647,6 +1082,13 @@ def from_torch(module) -> tuple[TorchBridge, dict]:
         # self.mask[:t, :t] trace to get_attr + getitem instead of
         # slicing a concrete tensor with a Proxy (a TypeError)
         proxy_buffer_attributes = True
+
+        def is_leaf_module(self, m, qualname):
+            # leaf iff we have a converter; torch.nn COMPOSITES
+            # (TransformerEncoderLayer, nn.Transformer, ...) trace
+            # through to their Linear/LayerNorm/MHA/Dropout leaves —
+            # unlike fx's default, which stops at every torch.nn module
+            return isinstance(m, _leaf_types())
 
     was_training = module.training
     module.eval()  # functional dropout etc. trace with training=False
