@@ -115,14 +115,22 @@ def cmd_fit(args: argparse.Namespace) -> int:
 
     from . import AutoDistribute
     from .models import GPT2, Llama, MoE
-    from .training import moe_next_token_loss, next_token_loss
+    from .training import (
+        blockwise_next_token_loss,
+        moe_next_token_loss,
+        next_token_loss,
+    )
 
     family = {"gpt2": GPT2, "llama": Llama, "moe": MoE}[args.family]
     size = args.size or {"gpt2": "1p3b", "llama": "8b", "moe": "test"}[
         args.family
     ]
     model = family(size, max_seq_len=args.seq)
-    loss = moe_next_token_loss if args.family == "moe" else next_token_loss
+    if args.loss == "blockwise":
+        loss = blockwise_next_token_loss()
+    else:
+        loss = (moe_next_token_loss if args.family == "moe"
+                else next_token_loss)
     ad = AutoDistribute(
         model,
         optimizer=optax.adamw(1e-4),
@@ -229,6 +237,10 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--strategy", default="search")
     p.add_argument("--precision", default="mixed")
+    p.add_argument("--loss", default="full", choices=("full", "blockwise"),
+                   help="blockwise = vocab-blockwise CE (never "
+                        "materializes [B,S,V] logits; big-vocab models "
+                        "fit far smaller)")
     p.set_defaults(fn=cmd_fit)
 
     p = sub.add_parser(

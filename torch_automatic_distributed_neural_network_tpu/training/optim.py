@@ -116,6 +116,10 @@ def adamw_cosine(
     tx = optax.adamw(
         warmup_cosine(peak_lr, total_steps, warmup_steps=warmup_steps),
         b1=b1, b2=b2, weight_decay=weight_decay,
+        # the GPT recipe decays matrices only: norm scales/biases and
+        # other vectors train without decay (torch reference analog:
+        # the no_decay param-group split)
+        mask=lambda params: jax.tree.map(lambda p: p.ndim >= 2, params),
     )
     if grad_clip:
         tx = optax.chain(optax.clip_by_global_norm(grad_clip), tx)
