@@ -35,10 +35,32 @@ _HEADER = np.dtype([
     ("pad", "<u4"), ("n_tokens", "<u8"),
 ])
 
+# the C++ source ships INSIDE the package (works from a wheel install);
+# the repo-root native/ dir symlinks to it for the checkout layout
 _REPO_NATIVE = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "native",
 )
+
+
+def _so_target(src: str) -> str:
+    """Where to place the compiled .so: next to the source when that
+    directory is writable (repo checkout / editable install), else a
+    per-user cache dir (read-only site-packages wheel install), keyed
+    on the source hash so caches from different installed versions
+    never collide (the ABI/determinism contract may differ)."""
+    d = os.path.dirname(src)
+    if os.access(d, os.W_OK):
+        return os.path.join(d, "libtadnn_loader.so")
+    import hashlib
+
+    with open(src, "rb") as f:
+        tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    cache = os.path.join(
+        os.environ.get("XDG_CACHE_HOME",
+                       os.path.expanduser("~/.cache")), "tadnn")
+    os.makedirs(cache, exist_ok=True)
+    return os.path.join(cache, f"libtadnn_loader-{tag}.so")
 
 _MASK64 = (1 << 64) - 1
 
@@ -132,8 +154,10 @@ def _native_lib() -> Any | None:
         if _lib is not None or _lib_failed:
             return _lib
         src = os.path.join(_REPO_NATIVE, "tadnn_loader.cpp")
-        so = os.path.join(_REPO_NATIVE, "libtadnn_loader.so")
         try:
+            # inside the try: an unwritable cache dir must mean
+            # 'native unavailable' (numpy fallback), not a crash
+            so = _so_target(src)
             if (
                 not os.path.exists(so)
                 or os.path.getmtime(so) < os.path.getmtime(src)
