@@ -943,7 +943,7 @@ def bench_pipeline(args):
             # bubble matters (small M); V=2 over the 8-layer stack
             scheds = ["dense", "cond", "1f1b"]
             if M % stages == 0:
-                scheds.append("interleaved")
+                scheds += ["interleaved", "interleaved_1f1b"]
             for sched in scheds:
                 ad = tad.AutoDistribute(
                     GPT2("test", vocab_size=vocab, max_seq_len=seq,
@@ -954,7 +954,8 @@ def bench_pipeline(args):
                     pipeline_stages=stages,
                     microbatches=M,
                     pipeline_schedule=sched,
-                    pipeline_virtual=2 if sched == "interleaved" else 1,
+                    pipeline_virtual=2 if sched.startswith("interleaved")
+                    else 1,
                 )
                 state = ad.step(ad.init(jax.random.key(0), data.batch(0)),
                                 data.batch(0))[0]  # compile+warm
@@ -976,6 +977,10 @@ def bench_pipeline(args):
                     "interleaved_ms": round(times["interleaved"] * 1e3, 1),
                     "interleaved_vs_cond": round(
                         times["interleaved"] / times["cond"], 3),
+                    "interleaved_1f1b_ms": round(
+                        times["interleaved_1f1b"] * 1e3, 1),
+                    "interleaved_1f1b_vs_cond": round(
+                        times["interleaved_1f1b"] / times["cond"], 3),
                     "bubble_frac_v2": round(
                         (stages - 1) / (M * 2 + stages - 1), 3),
                 } if "interleaved" in times else {}),
@@ -984,6 +989,7 @@ def bench_pipeline(args):
             log(f"pipe={stages} M={M}: dense {row['dense_ms']}ms "
                 f"cond {row['cond_ms']}ms 1f1b {row['onef_oneb_ms']}ms"
                 + (f" interleavedV2 {row['interleaved_ms']}ms"
+                   f" inter1f1b {row['interleaved_1f1b_ms']}ms"
                    if "interleaved_ms" in row else "")
                 + f" -> cond {row['speedup']}x, 1f1b/cond "
                 f"{row['onef_vs_cond']}x (bubble {row['bubble_frac']:.0%})")

@@ -109,10 +109,14 @@ class AutoDistribute:
         step FLOPs than the remat-everything policy, in exchange for the
         M-independent memory bound) | 'interleaved' (Megatron V virtual
         stages per device via ``pipeline_virtual``: bubble shrinks
-        V-fold to (S-1)/(MV+S-1); microbatches % stages must be 0).
+        V-fold to (S-1)/(MV+S-1); microbatches % stages must be 0) |
+        'interleaved_1f1b' (both: V-fold bubble shrink AND the
+        M-independent 2VS-1 stash-ring memory bound).
         All trajectory-identical; see parallel/pipeline.py.
     pipeline_virtual:
-        V for pipeline_schedule='interleaved'; ignored otherwise.
+        V (>= 2) for pipeline_schedule='interleaved' /
+        'interleaved_1f1b'; passing > 1 with any other schedule is a
+        config error (ValueError), not silently ignored.
     grad_accum:
         Accumulate gradients over this many sequential slices of every
         batch before the (single) optimizer update — train with k x the

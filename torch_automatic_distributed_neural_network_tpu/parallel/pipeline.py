@@ -58,8 +58,12 @@ the V-chunks-per-tick layout sketch), so the forward takes
 ``MV + S - 1`` ticks and the bubble fraction shrinks V-fold to
 ``(S-1)/(MV + S - 1)``.  Constraint: ``M % S == 0`` (Megatron's
 microbatch grouping).  Backward is reverse-mode AD through the scan
-(GPipe-style), so live stash grows to MV chunk inputs — interleaved ×
-1f1b (which would bound that) is not implemented.
+(GPipe-style), so live stash grows to MV chunk inputs.
+
+``schedule='interleaved_1f1b'`` combines both: the interleaved forward
+under custom_vjp plus a hand-scheduled backward over the REVERSED chunk
+chain (:func:`onef_oneb_grads_interleaved`) — live stash bounded by the
+2VS-1 ring (M-independent) AND the V-fold bubble shrink.
 """
 
 from __future__ import annotations
@@ -194,6 +198,152 @@ def spmd_pipeline(
     # (callers cast back outside the region).
     masked = jnp.where(stage == S - 1, outputs, jnp.zeros_like(outputs))
     return jax.lax.psum(masked.astype(jnp.float32), axis_name)
+
+
+def spmd_pipeline_interleaved(
+    stage_fn: Callable[[Any, jax.Array, jax.Array, jax.Array], jax.Array],
+    stage_params: Any,
+    microbatches: jax.Array,
+    *,
+    n_stages: int,
+    virtual: int,
+    axis_name: str = "pipe",
+    schedule: str = "cond",
+) -> jax.Array:
+    """Megatron interleaved forward: V virtual stages per device.
+
+    Must run inside `shard_map` manual over ``axis_name``.
+    ``stage_params`` leaves are ``[V, C, ...]`` per device (the global
+    ``[V, S, C]`` view sharded on dim 1); ``stage_fn(chunk_params, x,
+    mb_idx, v_idx)`` applies one C-layer chunk.
+
+    Chunk q = v*S + s lives on device s = q % S — so the chain q -> q+1
+    is exactly the ring hop i -> i+1, except the wrap S-1 -> 0 advances
+    the virtual index, and v=0 on device 0 ingests fresh microbatches.
+    Device s's k-th chunk execution (at tick t = s + k) handles::
+
+        v = (k // S) % V
+        m = (k // (S*V)) * S + k % S        (requires M % S == 0)
+
+    This order satisfies both dependencies tick-tight: the same-(v,m)
+    producer on device s-1 finished at t-1, and device 0's (v,m) needs
+    (v-1,m) from device S-1, which finished at t-1 as well (k differs by
+    exactly S).  ``M*V + S - 1`` ticks of one C-layer chunk each.
+    """
+    if schedule not in ("cond", "dense"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    S, V = n_stages, virtual
+    M = microbatches.shape[0]
+    if M % S:
+        raise ValueError(
+            f"interleaved schedule needs microbatches % stages == 0 "
+            f"(Megatron grouping); got M={M}, S={S}"
+        )
+    stage = jax.lax.axis_index(axis_name)
+    microbatches = _to_varying(microbatches, axis_name)
+
+    act0 = jnp.zeros_like(microbatches[0])
+    outputs0 = jnp.zeros_like(microbatches)
+    perm = [(i, (i + 1) % S) for i in range(S)]
+    T = M * V + S - 1
+
+    def body(carry, t):
+        act, outputs = carry
+        k = t - stage  # this device's chunk-execution index
+        work = jnp.logical_and(k >= 0, k < M * V)
+        kc = jnp.clip(k, 0, M * V - 1)
+        v = (kc // S) % V
+        m = (kc // (S * V)) * S + kc % S
+        # v=0 on device 0 ingests microbatch m; everything else takes
+        # the ring activation (see the tick-tightness argument above)
+        inp = jnp.where(
+            jnp.logical_and(stage == 0, v == 0),
+            jax.lax.dynamic_index_in_dim(microbatches, m, 0, keepdims=False),
+            act,
+        )
+        chunk_params = jax.tree.map(
+            lambda p: jax.lax.dynamic_index_in_dim(p, v, 0, keepdims=False),
+            stage_params,
+        )
+        if schedule == "cond":
+            out = jax.lax.cond(
+                work,
+                lambda a: stage_fn(chunk_params, a, m, v),
+                lambda a: a,
+                inp,
+            )
+        else:
+            out = stage_fn(chunk_params, inp, m, v)
+        # the chain's last chunk (v = V-1 on device S-1) completes m
+        is_done = jnp.logical_and(
+            jnp.logical_and(stage == S - 1, v == V - 1), work
+        )
+        cur = jax.lax.dynamic_index_in_dim(outputs, m, 0, keepdims=False)
+        outputs = jax.lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(is_done, out, cur), m, 0
+        )
+        nxt = jax.lax.ppermute(out, axis_name, perm)
+        return (nxt, outputs), None
+
+    (_, outputs), _ = jax.lax.scan(body, (act0, outputs0), jnp.arange(T))
+    masked = jnp.where(stage == S - 1, outputs, jnp.zeros_like(outputs))
+    return jax.lax.psum(masked.astype(jnp.float32), axis_name)
+
+
+# ---------------------------------------------------------------------------
+# 1F1B: memory-bounded backward schedule
+# ---------------------------------------------------------------------------
+
+
+def onef_oneb_grads(
+    stage_fn: Callable[[Any, jax.Array, jax.Array], jax.Array],
+    stage_params: Any,
+    microbatches: jax.Array,
+    cotangents: jax.Array,
+    *,
+    n_stages: int,
+    axis_name: str = "pipe",
+) -> tuple[Any, jax.Array]:
+    """Hand-scheduled 1F1B combined forward+backward pass.
+
+    Runs inside the same partial-manual ``shard_map`` region as
+    :func:`spmd_pipeline`; returns ``(param_grads, input_cotangents)``
+    for the whole trunk given output ``cotangents`` of shape
+    ``[M, mb, ...]``.
+
+    Why a hand-written backward at all: reverse-mode AD through the GPipe
+    scan stashes one stage-input per iteration — ``M + S - 1`` live
+    activations — and (jax 0.9) refuses `lax.cond` in the differentiated
+    path when branches carry different residuals (dropout).  This
+    schedule is not differentiated — each backward tick recomputes its
+    stage forward from a stashed input and applies the cotangent with an
+    explicit ``jax.vjp`` — so both limits disappear: live stage inputs
+    are a ``2S - 1`` ring independent of M, and bubbles skip compute via
+    ``lax.cond`` even with dropout on.
+
+    FLOP accounting, in forward-units (bwd ~= 2 fwd): this pass runs the
+    forward wavefront (to regenerate inter-stage activations and
+    stashes) + per-tick vjp recompute + backward = 4 units, on top of
+    the primal forward the custom_vjp wrapper already ran = **5 units
+    total, vs 4 for AD-GPipe with the remat-everything policy** — one
+    extra forward (~25% more step FLOPs) is the price of the
+    M-independent memory bound.  Worth it exactly when M must be large
+    (deep pipelines want M >> S to kill the bubble fraction) and
+    activations, not FLOPs, are the binding constraint.
+
+    Implementation: the exact ``V=1`` case of
+    :func:`onef_oneb_grads_interleaved` — with one chunk per device the
+    interleaved tick/ring algebra reduces line-for-line to the classic
+    1F1B lockstep (j = t - 2S + 1 + s, ring 2S-1), so ONE scheduler
+    carries both proofs.
+    """
+    wrapped = jax.tree.map(lambda p: p[None], stage_params)
+    dparams, dmbs = onef_oneb_grads_interleaved(
+        lambda params, x, m, v: stage_fn(params, x, m),
+        wrapped, microbatches, cotangents,
+        n_stages=n_stages, virtual=1, axis_name=axis_name,
+    )
+    return jax.tree.map(lambda p: p.squeeze(0), dparams), dmbs
 
 
 def spmd_pipeline_interleaved(
@@ -453,6 +603,178 @@ def onef_oneb_grads(
     return dparams, jax.lax.psum(masked, axis_name)
 
 
+def onef_oneb_grads_interleaved(
+    stage_fn: Callable[[Any, jax.Array, jax.Array, jax.Array], jax.Array],
+    stage_params: Any,          # leaves [V, C, ...] per device
+    microbatches: jax.Array,
+    cotangents: jax.Array,
+    *,
+    n_stages: int,
+    virtual: int,
+    axis_name: str = "pipe",
+) -> tuple[Any, jax.Array]:
+    """Interleaved 1F1B: the hand-scheduled backward over the V*S virtual
+    chunk chain.
+
+    Schedule (Q = V*S; forward exactly :func:`spmd_pipeline_interleaved`'s
+    k-ordering): device s's j-th BACKWARD execution handles::
+
+        v = V-1 - (j // S) % V          (the forward's v, reversed)
+        m = (j // (S*V)) * S + j % S
+        at tick t = Q + (S-1-s) + j
+
+    Tick-tightness mirrors the forward proofs: bwd(q) needs bwd(q+1)
+    from device s+1 one tick earlier (same j, one smaller device skew),
+    and the S-1 -> 0 chain wrap advances v with j differing by exactly S.
+    The first backward (chunk Q-1, m=0, device S-1, j=0, t=Q) fires one
+    tick after its forward (t=Q-1) — the delay D=Q is minimal.
+
+    Memory: the stash ring holds ``2Q - 1`` chunk inputs (a chunk input
+    is written at fwd index k and read at bwd index j with
+    k - j <= 2Q - 1 - ...; the bound is the V=1 ring's 2S-1 scaled by
+    V), still INDEPENDENT of M — unlike AD through the interleaved
+    forward, whose stash grows as M*V.  Wall-clock: T = MV + Q + S - 1
+    ticks of 1/V-stage compute ~= (M + S + (S-1)/V) stage-units vs 1F1B's
+    (M + 2S - 1): strictly fewer for V > 1.
+    """
+    S, V = n_stages, virtual
+    Q = V * S
+    M = microbatches.shape[0]
+    if V > 1 and M % S:
+        # the grouped (v, m) ordering needs whole groups of S; with one
+        # chunk per device (V=1, classic 1F1B) m(k) = k and any M works
+        raise ValueError(
+            f"interleaved schedule needs microbatches % stages == 0; "
+            f"got M={M}, S={S}"
+        )
+    B = 2 * Q - 1
+    stage = jax.lax.axis_index(axis_name)
+
+    microbatches = _to_varying(microbatches, axis_name)
+    cotangents = _to_varying(cotangents, axis_name)
+
+    act0 = jnp.zeros_like(microbatches[0])
+    cot0 = jnp.zeros_like(cotangents[0])
+    stash0 = _to_varying(
+        jnp.zeros((B,) + act0.shape, act0.dtype), axis_name
+    )
+    dparams0 = jax.tree.map(
+        lambda p: _to_varying(jnp.zeros(p.shape, jnp.float32), axis_name),
+        stage_params,
+    )
+    dmbs0 = jnp.zeros_like(microbatches)
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+    bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+
+    def chunk_of(idx, v):
+        """(group, residue) of execution index ``idx`` recombined with
+        virtual stage ``v`` -> the forward execution index k."""
+        return (idx // (S * V)) * (S * V) + v * S + idx % S
+
+    def tick(carry, t):
+        act, cot, stash, dparams, dmbs = carry
+
+        # ---- backward indices; stash read FIRST (ring aliasing: the
+        # forward may write this very slot later in the same tick) ----
+        j = t - Q - (S - 1 - stage)
+        work_b = jnp.logical_and(j >= 0, j < M * V)
+        jc = jnp.clip(j, 0, M * V - 1)
+        v_b = V - 1 - (jc // S) % V
+        m_b = (jc // (S * V)) * S + jc % S
+        k_read = chunk_of(jc, v_b)  # where this chunk's fwd stashed
+        x0 = jax.lax.dynamic_index_in_dim(
+            stash, k_read % B, 0, keepdims=False)
+
+        # ---- forward slot (spmd_pipeline_interleaved's schedule) ----
+        k = t - stage
+        work_f = jnp.logical_and(k >= 0, k < M * V)
+        kc = jnp.clip(k, 0, M * V - 1)
+        v_f = (kc // S) % V
+        m_f = (kc // (S * V)) * S + kc % S
+        inp = jnp.where(
+            jnp.logical_and(stage == 0, v_f == 0),
+            jax.lax.dynamic_index_in_dim(
+                microbatches, m_f, 0, keepdims=False),
+            act,
+        )
+        fwd_params = jax.tree.map(
+            lambda p: jax.lax.dynamic_index_in_dim(
+                p, v_f, 0, keepdims=False),
+            stage_params,
+        )
+        y = jax.lax.cond(
+            work_f,
+            lambda a: stage_fn(fwd_params, a, m_f, v_f),
+            lambda a: a,
+            inp,
+        )
+        slot_f = kc % B
+        old = jax.lax.dynamic_index_in_dim(stash, slot_f, 0,
+                                           keepdims=False)
+        stash = jax.lax.dynamic_update_index_in_dim(
+            stash, jnp.where(work_f, inp, old), slot_f, 0
+        )
+
+        # ---- backward compute ----
+        g_in = jnp.where(
+            jnp.logical_and(stage == S - 1, v_b == V - 1),
+            jax.lax.dynamic_index_in_dim(cotangents, m_b, 0,
+                                         keepdims=False),
+            cot,
+        )
+        bwd_params = jax.tree.map(
+            lambda p: jax.lax.dynamic_index_in_dim(
+                p, v_b, 0, keepdims=False),
+            stage_params,
+        )
+
+        def do_bwd(operand):
+            x0, g = operand
+            _, vjp_fn = jax.vjp(
+                lambda p, xx: stage_fn(p, xx, m_b, v_b), bwd_params, x0
+            )
+            dp, dx = vjp_fn(g)
+            return jax.tree.map(
+                lambda a: a.astype(jnp.float32), dp
+            ), dx.astype(jnp.float32)
+
+        def no_bwd(operand):
+            _, g = operand
+            return jax.tree.map(
+                lambda p: _to_varying(
+                    jnp.zeros(p.shape, jnp.float32), axis_name
+                ),
+                bwd_params,
+            ), g.astype(jnp.float32)
+
+        dp, dx = jax.lax.cond(work_b, do_bwd, no_bwd, (x0, g_in))
+        # scatter-add this chunk's param grads into virtual slot v_b
+        dparams = jax.tree.map(
+            lambda acc, d: acc.at[v_b].add(d), dparams, dp
+        )
+        # chunk 0 (v=0, device 0) emits the trunk-input cotangent
+        store = jnp.logical_and(
+            jnp.logical_and(stage == 0, v_b == 0), work_b)
+        cur = jax.lax.dynamic_index_in_dim(dmbs, m_b, 0, keepdims=False)
+        dmbs = jax.lax.dynamic_update_index_in_dim(
+            dmbs, jnp.where(store, dx.astype(dmbs.dtype), cur), m_b, 0
+        )
+
+        act = jax.lax.ppermute(y, axis_name, fwd_perm)
+        cot = jax.lax.ppermute(dx, axis_name, bwd_perm)
+        return (act, cot, stash, dparams, dmbs), None
+
+    T = M * V + Q + S - 1
+    (_, _, _, dparams, dmbs), _ = jax.lax.scan(
+        tick, (act0, cot0, stash0, dparams0, dmbs0), jnp.arange(T)
+    )
+    dparams = jax.tree.map(
+        lambda g, p: g.astype(p.dtype), dparams, stage_params
+    )
+    masked = jnp.where(stage == 0, dmbs, jnp.zeros_like(dmbs))
+    return dparams, jax.lax.psum(masked, axis_name)
+
+
 # ---------------------------------------------------------------------------
 # DecoderLM integration
 # ---------------------------------------------------------------------------
@@ -486,7 +808,8 @@ def make_pipelined_apply(
     """
     from ..models.transformer_core import DecoderLayer, DecoderLM, make_norm
 
-    if schedule not in ("cond", "dense", "1f1b", "interleaved"):
+    if schedule not in ("cond", "dense", "1f1b", "interleaved",
+                        "interleaved_1f1b"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
     if not isinstance(model, DecoderLM):
         raise TypeError(
@@ -499,7 +822,7 @@ def make_pipelined_apply(
     S = topo_mod.mesh_degrees(mesh).get(axis_name, 1)
     if S <= 1:
         raise ValueError(f"mesh has no {axis_name!r} axis > 1")
-    interleaved = schedule == "interleaved"
+    interleaved = schedule in ("interleaved", "interleaved_1f1b")
     V = virtual if interleaved else 1
     if interleaved and V < 2:
         raise ValueError(
@@ -708,20 +1031,35 @@ def make_pipelined_apply(
             positions_mbs, mask_mbs = _unpack_extras(
                 extras, b, has_pos, has_mask
             )
+            stage_fn = make_stage_fn(key_data, positions_mbs, mask_mbs,
+                                     use_dropout)
             with _region_ctx():
-                dparams, dmbs = onef_oneb_grads(
-                    make_stage_fn(key_data, positions_mbs, mask_mbs,
-                                  use_dropout),
-                    layer_params, _split_mb(x, b), _split_mb(g, b),
-                    n_stages=S, axis_name=axis_name,
-                )
+                if interleaved:
+                    local = jax.tree.map(
+                        lambda p: p.squeeze(1), layer_params
+                    )
+                    dparams, dmbs = onef_oneb_grads_interleaved(
+                        stage_fn, local, _split_mb(x, b), _split_mb(g, b),
+                        n_stages=S, virtual=V, axis_name=axis_name,
+                    )
+                    # restore the sharded [V, 1, C, ...] layout
+                    dparams = jax.tree.map(
+                        lambda p: p[:, None], dparams
+                    )
+                else:
+                    dparams, dmbs = onef_oneb_grads(
+                        stage_fn, layer_params, _split_mb(x, b),
+                        _split_mb(g, b),
+                        n_stages=S, axis_name=axis_name,
+                    )
             return dparams, dmbs.reshape(x.shape)
 
+        layer_spec = P(None, axis_name) if interleaved else P(axis_name)
         bwd_pipe = shard_map(
             bwd_region,
             mesh=mesh,
-            in_specs=(P(axis_name), P(), P()) + (P(),) * (n_extras + 1),
-            out_specs=(P(axis_name), P()),
+            in_specs=(layer_spec, P(), P()) + (P(),) * (n_extras + 1),
+            out_specs=(layer_spec, P()),
             axis_names={axis_name},
         )
 
@@ -775,7 +1113,7 @@ def make_pipelined_apply(
         # AllReducePromotion pass (reducer contains a Sharding custom-call
         # it cannot clone), and fp32 residual transport across stage hops
         # is numerically conservative anyway.  Stage compute stays bf16.
-        if schedule == "1f1b":
+        if schedule in ("1f1b", "interleaved_1f1b"):
             pipe = make_trunk_1f1b(positions is not None, mask is not None,
                                    use_dropout)
         else:
