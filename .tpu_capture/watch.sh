@@ -19,10 +19,15 @@ while true; do
   if [ -z "$job" ]; then sleep 30; continue; fi
   echo "[watch $(date +%H:%M:%S)] probing (pending: $job)"
   if timeout 90 python -c "import jax; print(jax.devices()[0].device_kind)" >/dev/null 2>&1; then
-    # pop-before-run, atomically w.r.t. concurrent appends
-    flock "$DIR/queue.txt" bash -c '
-      grep -vxF "$1" "$0" > "$0.tmp" && mv "$0.tmp" "$0"
-    ' "$DIR/queue.txt" "$job"
+    # pop-before-run, atomically w.r.t. concurrent appends; remove only
+    # the FIRST matching line so intentionally queued duplicates each
+    # get their own run (round-4 advisor).  The job reaches awk via
+    # ENVIRON, not -v: -v backslash-processes the value, so a job
+    # containing '\' would never match and re-run forever.
+    flock "$DIR/queue.txt" env JOB="$job" bash -c '
+      awk "!done && \$0 == ENVIRON[\"JOB\"] {done=1; next} {print}" \
+        "$0" > "$0.tmp" && mv "$0.tmp" "$0"
+    ' "$DIR/queue.txt"
     n=$((n+1))
     log="$DIR/logs/$(date +%m%d-%H%M%S)-$n.log"
     echo "[watch $(date +%H:%M:%S)] TPU UP — running: $job -> $log"

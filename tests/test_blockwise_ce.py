@@ -206,3 +206,35 @@ def test_adamw_cosine_decay_mask():
     updates, _ = tx.update(grads, state, params)
     assert float(jnp.abs(updates["w"]).max()) > 0.0      # decayed
     assert float(jnp.abs(updates["scale"]).max()) == 0.0  # masked
+
+
+def test_adamw_cosine_decay_mask_scanned_layers():
+    """The mask is path-based, not ndim-based: nn.scan-stacked layer
+    params carry a leading [L] axis, so stacked norm scales/biases are
+    rank 2 and an ndim>=2 mask would decay them (round-4 advisor)."""
+    from torch_automatic_distributed_neural_network_tpu.training.optim import (
+        adamw_cosine, decay_mask,
+    )
+
+    params = {
+        "layers": {
+            "mlp": {"kernel": jnp.ones((3, 4, 4)),   # [L, d, d]
+                    "bias": jnp.ones((3, 4))},        # [L, d] — rank 2!
+            "norm": {"scale": jnp.ones((3, 4))},      # [L, d] — rank 2!
+        },
+        "embedding": jnp.ones((8, 4)),
+    }
+    mask = decay_mask(params)
+    assert mask["layers"]["mlp"]["kernel"] is True
+    assert mask["layers"]["mlp"]["bias"] is False
+    assert mask["layers"]["norm"]["scale"] is False
+    assert mask["embedding"] is True
+
+    grads = jax.tree.map(jnp.zeros_like, params)
+    tx = adamw_cosine(peak_lr=1.0, total_steps=10, warmup_steps=0,
+                      weight_decay=0.5, grad_clip=0.0)
+    state = tx.init(params)
+    updates, _ = tx.update(grads, state, params)
+    assert float(jnp.abs(updates["layers"]["mlp"]["kernel"]).max()) > 0.0
+    assert float(jnp.abs(updates["layers"]["mlp"]["bias"]).max()) == 0.0
+    assert float(jnp.abs(updates["layers"]["norm"]["scale"]).max()) == 0.0

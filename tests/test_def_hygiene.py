@@ -1,0 +1,45 @@
+"""Guard against silently-shadowed top-level definitions.
+
+Round-4 advisor finding: ``parallel/pipeline.py`` carried ~240 lines of
+dead code because a bad merge left two top-level ``def`` statements with
+the same name — Python's last-def-wins made it invisible at runtime.
+This scan fails loudly if any module in the package (or this test tree)
+defines the same top-level name twice.
+"""
+
+import ast
+import pathlib
+
+import torch_automatic_distributed_neural_network_tpu as tad
+
+PKG_ROOT = pathlib.Path(tad.__file__).parent
+REPO_ROOT = PKG_ROOT.parent
+
+
+def _duplicate_toplevel_names(path: pathlib.Path) -> list[str]:
+    tree = ast.parse(path.read_text())
+    seen: dict[str, int] = {}
+    dups = []
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            if node.name in seen:
+                dups.append(
+                    f"{path.relative_to(REPO_ROOT)}:{node.lineno} "
+                    f"shadows {node.name!r} first defined at line "
+                    f"{seen[node.name]}"
+                )
+            else:
+                seen[node.name] = node.lineno
+    return dups
+
+
+def test_no_shadowed_toplevel_defs():
+    files = sorted(PKG_ROOT.rglob("*.py"))
+    files += sorted((REPO_ROOT / "tests").glob("*.py"))
+    for extra in ("bench.py", "__graft_entry__.py", "tpu_probe.py"):
+        if (REPO_ROOT / extra).exists():
+            files.append(REPO_ROOT / extra)
+    assert files, "package sources not found"
+    problems = [d for f in files for d in _duplicate_toplevel_names(f)]
+    assert not problems, "shadowed top-level defs:\n" + "\n".join(problems)

@@ -124,3 +124,63 @@ def test_dryrun_multichip_end_to_end_with_poisoned_parent(tmp_path):
     )
     assert proc.returncode == 0, proc.stderr[-3000:]
     assert "tp_fsdp ok" in proc.stdout, proc.stdout[-2000:]
+
+
+def test_dryrun_reexec_streams_progress_and_finishes_in_budget():
+    """The r04 artifact failure mode: the re-exec child's output was
+    buffered (capture_output=True), so a driver-side timeout kill left
+    nothing in the artifact tail.  Pin the fix's two properties:
+
+    1. per-config progress lines appear on the PARENT's stdout while the
+       parent is still running (streamed, not buffered-at-exit);
+    2. the single-config re-exec path completes under a hard wall-clock
+       budget (the full 7-config dryrun is sized to fit the driver's
+       budget warm; this pins the machinery's overhead, and the
+       compile-cache env vars keep repeat runs warm).
+    """
+    import time
+
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # default (axon-like) driver env
+    env["XLA_FLAGS"] = ""
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon" not in p
+    )
+    env["_TADNN_DRYRUN_ONLY"] = "tp_fsdp"
+    code = (
+        "import sys; sys.path.insert(0, {root!r}); "
+        "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
+    ).format(root=_REPO_ROOT)
+    import threading
+
+    t0 = time.perf_counter()
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code], env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+    # watchdog: a hang regression (the very thing this test pins) must
+    # FAIL the test, not wedge the reader loop below waiting for EOF
+    watchdog = threading.Timer(600, proc.kill)
+    watchdog.start()
+    streamed_while_running = False
+    lines = []
+    try:
+        for line in proc.stdout:
+            lines.append(line)
+            if "starting..." in line and proc.poll() is None:
+                streamed_while_running = True
+        rc = proc.wait(timeout=30)
+    finally:
+        watchdog.cancel()
+        if proc.poll() is None:
+            proc.kill()
+    out = "".join(lines)
+    assert rc == 0, out[-3000:]
+    assert streamed_while_running, (
+        "no per-config marker arrived while the parent was running — "
+        "child output is being buffered again:\n" + out[-2000:]
+    )
+    assert "ALL 1/1 configs ok" in out, out[-2000:]
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 600, f"single-config re-exec took {elapsed:.0f}s"

@@ -2,15 +2,42 @@
 like the public project it re-implements — `pip install -e .` exposes
 both import names and a `tadnn` console script.
 
-These tests assume the editable install has been done once in the dev
-environment (`pip install -e . --no-build-isolation`); they pin the
-metadata so a broken pyproject shows up as a test failure, not as a
-silently uninstallable artifact.
+The editable install is bootstrapped on demand: each round's container
+starts clean, so the suite self-installs the REPO'S OWN package —
+``--no-deps`` touches nothing external and ``--no-build-isolation``
+avoids fetching setuptools (zero-egress environment).  A broken
+pyproject then shows up as a test failure, not as a silently
+uninstallable artifact.
 """
 
 import importlib.metadata
+import os
+import subprocess
+import sys
 
 import pytest
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ensure_installed() -> None:
+    try:
+        importlib.metadata.distribution("tadnn-tpu")
+        return
+    except importlib.metadata.PackageNotFoundError:
+        proc = subprocess.run(
+            [sys.executable, "-m", "pip", "install", "-e", _REPO_ROOT,
+             "--no-deps", "--no-build-isolation"],
+            capture_output=True, text=True, timeout=300,
+        )
+        # a broken pyproject must FAIL the module, not skip it
+        assert proc.returncode == 0, (
+            "editable self-install failed (broken pyproject?):\n"
+            + proc.stderr[-2000:]
+        )
+
+
+_ensure_installed()
 
 
 def _dist():

@@ -168,3 +168,64 @@ def test_ep_tp_moe_rules_w2_is_fan_in(devices8):
     assert mlp["moe_w2"] == P("expert", "tensor")
     assert mlp["moe_w7"] == P("expert")  # unknown orientation: E dim only
     assert mlp["router"]["kernel"] == P()
+
+
+def test_tp_zero_match_warns(devices8):
+    """A tp strategy that matches no parameter must warn loudly instead
+    of silently replicating everything across the tensor axis (round-4
+    VERDICT #6: fx-sanitized bridge names can miss every rule)."""
+    mesh = tad.build_mesh(tensor=4, data=2)
+    params = {"blk": {"mystery_w": Shape(64, 64), "mystery_b": Shape(64)}}
+    with pytest.warns(UserWarning, match="ZERO parameters matched"):
+        planner.param_spec_tree(params, mesh, "tp")
+
+
+def test_tp_match_does_not_warn(devices8, recwarn):
+    mesh = tad.build_mesh(tensor=4, data=2)
+    planner.param_spec_tree(transformer_like_params(), mesh, "tp")
+    assert not [w for w in recwarn.list
+                if "ZERO parameters matched" in str(w.message)]
+
+
+def test_bridged_transformer_gets_tp_specs(devices8):
+    """from_torch of an nn.TransformerEncoder produces fx-sanitized
+    names (sa.in_w torch-layout packed qkv, lin1/lin2 flax-layout
+    kernels); the default rules must give them real Megatron col/row
+    splits — in_w [3d, d] splits its OUTPUT dim 0, out_w [d, d] its
+    contraction dim 1, lin1 [in, out] its output dim 1."""
+    torch = pytest.importorskip("torch")
+    tnn = torch.nn
+    from torch_automatic_distributed_neural_network_tpu.models.torch_bridge import (
+        from_torch,
+    )
+
+    enc = tnn.TransformerEncoder(
+        tnn.TransformerEncoderLayer(
+            32, 4, 64, dropout=0.0, batch_first=True, activation="gelu"),
+        num_layers=2).eval()
+
+    class Wrap(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.enc = enc
+
+        def forward(self, x):
+            return self.enc(x)
+
+    _, variables = from_torch(Wrap())
+    mesh = tad.build_mesh(tensor=4, data=2)
+    specs = planner.param_spec_tree(variables["params"], mesh, "tp")
+    flat = {
+        planner.path_str(kp): spec for kp, spec in
+        jax.tree_util.tree_flatten_with_path(
+            specs, is_leaf=lambda x: isinstance(x, P))[0]
+    }
+    by_suffix = {}
+    for path, spec in flat.items():
+        by_suffix.setdefault(path.rsplit(".", 1)[-1], set()).add(spec)
+    # trailing Nones are normalized off specs: ("tensor", None) -> ("tensor",)
+    assert by_suffix["in_w"] == {P("tensor")}
+    assert by_suffix["in_b"] == {P("tensor")}
+    assert by_suffix["out_w"] == {P(None, "tensor")}
+    assert by_suffix["kernel"] == {P(None, "tensor"), P("tensor")}
+    assert by_suffix["scale"] == {P()}

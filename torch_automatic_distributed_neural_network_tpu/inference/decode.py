@@ -122,17 +122,21 @@ def _moe_mlp_cached(lp_mlp: Any, h: jax.Array, cfg) -> jax.Array:
     return jnp.einsum("betd,bte->btd", y, w.astype(h.dtype))
 
 
-def _moe_mlp_routed(lp_mlp: Any, h: jax.Array, cfg, mesh=None) -> jax.Array:
+def _moe_mlp_routed(lp_mlp: Any, h: jax.Array, cfg, mesh=None,
+                    capacity_override: int | None = None) -> jax.Array:
     """Capacity-based decode routing: the TRAINING ``moe_ffn`` (same
     top_k_routing, same capacity math, same dispatch/combine einsums and
     expert-axis sharding constraints) applied to the decode chunk.
 
-    This is the bit-exact twin of a capacity-dropping training config:
-    a prefill chunk routes as one group of T tokens, so any token the
-    training forward would drop is dropped here too (the dense-combine
-    fast path above silently keeps it).  Single-token decode steps are a
-    1-token group — ``expert_capacity`` clamps to >= 8 slots, so steps
-    never drop and match the dense combine exactly.  Cost: the
+    Same routing RULE as training, with expert capacity derived from
+    the decode chunk's token count: a prefill chunk routes as one group
+    of T tokens, so drop decisions match a training batch only when the
+    chunk length equals the training group size (pass
+    ``capacity_override`` to pin the training value exactly).  The
+    dense-combine fast path above silently keeps dropped tokens.
+    Single-token decode steps are a 1-token group — ``expert_capacity``
+    clamps to >= 8 slots, so steps never drop and match the dense
+    combine exactly.  Cost: the
     O(capacity * E) dispatch tensors per chunk vs dense's O(E * T)
     broadcast — worth it for large E or when training/serving parity in
     dropping configs is required (VERDICT r3 weak #5).
@@ -153,6 +157,7 @@ def _moe_mlp_routed(lp_mlp: Any, h: jax.Array, cfg, mesh=None) -> jax.Array:
         capacity_factor=cfg.capacity_factor,
         act=jax.nn.silu if gate is not None else jax.nn.gelu,
         mesh=mesh,
+        capacity=capacity_override,
     )
     return y
 
@@ -164,6 +169,7 @@ def forward_cached(
     cache: KVCache,
     *,
     moe_decode: str = "dense",  # 'dense' | 'routed' (capacity-based)
+    moe_capacity: int | None = None,  # pin the training group's capacity
     mesh=None,
 ) -> tuple[jax.Array, KVCache]:
     """Run the decoder on a chunk against the cache; returns (logits of
@@ -217,7 +223,8 @@ def forward_cached(
         h = norm.apply({"params": lp["mlp_norm"]}, x)
         if "experts_up" in lp["mlp"]:
             if moe_decode == "routed":
-                x = x + _moe_mlp_routed(lp["mlp"], h, cfg, mesh)
+                x = x + _moe_mlp_routed(lp["mlp"], h, cfg, mesh,
+                                        moe_capacity)
             else:
                 x = x + _moe_mlp_cached(lp["mlp"], h, cfg)
         else:
@@ -310,6 +317,7 @@ def generate(
     mesh=None,
     eos_id: int | None = None,
     moe_decode: str = "dense",
+    moe_capacity: int | None = None,
 ) -> jax.Array:
     """Autoregressive generation: prefill + one-token lax.scan decode.
 
@@ -343,7 +351,8 @@ def generate(
             length=cache.length,
         )
     logits, cache = forward_cached(params, cfg, prompt, cache,
-                                   moe_decode=moe_decode, mesh=mesh)
+                                   moe_decode=moe_decode,
+                                   moe_capacity=moe_capacity, mesh=mesh)
     first = _sample(logits, first_rng, sample)
     done0 = (
         first == eos_id if eos_id is not None
@@ -352,8 +361,13 @@ def generate(
 
     def body(carry, step_rng):
         cache, tok, done = carry
+        # single-token steps never drop (the >=8-slot clamp), so the
+        # training-capacity pin only matters for prefill; forwarding it
+        # here would inflate every step's dispatch tensors to the
+        # training capacity for identical outputs
         logits, cache = forward_cached(params, cfg, tok[:, None], cache,
-                                       moe_decode=moe_decode, mesh=mesh)
+                                       moe_decode=moe_decode,
+                                       moe_capacity=None, mesh=mesh)
         nxt = _sample(logits, step_rng, sample)
         if eos_id is not None:
             nxt = jnp.where(done, eos_id, nxt)

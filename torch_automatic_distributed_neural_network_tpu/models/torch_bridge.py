@@ -883,9 +883,11 @@ class TorchBridge(nn.Module):
             scores = jnp.where(
                 key_padding_mask[:, None, None, :], neg, scores)
         probs = jax.nn.softmax(scores, axis=-1)
-        probs = self._drop(probs, cfg.get("attn_rate", cfg["rate"]),
-                           train)
-        out = (probs @ vh).transpose(0, 2, 1, 3).reshape(B, Tq, d)
+        # torch returns the PRE-dropout softmax as need_weights output
+        # while matmul-ing the dropped probs against V (round-4 advisor)
+        dropped = self._drop(probs, cfg.get("attn_rate", cfg["rate"]),
+                             train)
+        out = (dropped @ vh).transpose(0, 2, 1, 3).reshape(B, Tq, d)
         out = out @ self._p(scope, prefix + "out_w").T
         if prefix + "out_b" in names:
             out = out + self._p(scope, prefix + "out_b")

@@ -385,6 +385,25 @@ def _pad_to(x, target, dim):
     return jnp.pad(x, widths)
 
 
+# Per-seq (block_q, block_k) fwd+bwd winners, measured on a live v5e
+# (BENCH_NOTES.md round-5 `mode=attention sweep=1`: 36.1% HW util @ 8k
+# with 512x2048 vs 29.2% for the old 1024x1024 default; 40.3% @ 16k with
+# 1024x1024; 25.2% @ 2k with 512x2048).  2048-wide q blocks, and
+# bq>=1024 x bk>=1024 combinations beyond these, exceed the compile
+# helper's VMEM budget and fail to compile.
+_MEASURED_BLOCKS = {
+    2048: (512, 2048),
+    8192: (512, 2048),
+    16384: (1024, 1024),
+}
+
+
+def default_blocks(seq_k: int) -> tuple[int, int]:
+    """Measured per-seq block defaults (nearest swept seq_k wins)."""
+    key = min(_MEASURED_BLOCKS, key=lambda sw: abs(sw - seq_k))
+    return _MEASURED_BLOCKS[key]
+
+
 def _prep_bshd(q, k, v, causal, block_q, block_k, interpret):
     """Shared BSHD preprocessing: GQA broadcast, fold to [B*H, S, D], pad
     to block multiples.  Returns (qf, kf, vf, cfg, (b, hq, sq, d))."""
@@ -392,6 +411,10 @@ def _prep_bshd(q, k, v, causal, block_q, block_k, interpret):
         interpret = _default_interpret()
     b, sq, hq, d = q.shape
     sk, hk = k.shape[1], k.shape[2]
+    if block_q is None or block_k is None:
+        dq, dk = default_blocks(sk)
+        block_q = dq if block_q is None else block_q
+        block_k = dk if block_k is None else block_k
     if hk != hq:
         assert hq % hk == 0, (hq, hk)
         k = jnp.repeat(k, hq // hk, axis=2)
@@ -424,8 +447,8 @@ def flash_attention(
     v: jax.Array,
     *,
     causal: bool = False,
-    block_q: int = 1024,
-    block_k: int = 1024,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Flash attention over BSHD tensors [batch, seq, heads, head_dim].
@@ -434,9 +457,10 @@ def flash_attention(
     tests compare against) while never materializing the [S, S] score
     matrix.  K/V may have fewer heads (GQA) — broadcast to Q's head count.
 
-    Block defaults were tuned on a live v5e: 1024x1024 runs the fwd+bwd
-    step ~5x faster than XLA's einsum attention at seq 2048 (d=64);
-    2048-wide q blocks exceed VMEM and fail to compile.
+    Block defaults resolve per-sequence from a live-v5e sweep
+    (:func:`default_blocks`; BENCH_NOTES.md round-5 block sweep):
+    512x2048 up to seq 8k, 1024x1024 at 16k+.  2048-wide q blocks
+    exceed the VMEM budget and fail to compile.
     """
     qf, kf, vf, cfg, (b, hq, sq, d) = _prep_bshd(
         q, k, v, causal, block_q, block_k, interpret
@@ -453,8 +477,8 @@ def flash_attention_with_lse(
     v: jax.Array,
     *,
     causal: bool = False,
-    block_q: int = 1024,
-    block_k: int = 1024,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Flash attention returning ``(o, lse)`` — ``o`` as BSHD, ``lse``

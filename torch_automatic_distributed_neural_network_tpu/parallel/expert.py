@@ -144,16 +144,22 @@ def moe_ffn(
     mesh: Mesh | None = None,
     expert_axis: str = "expert",
     batch_axes: tuple[str, ...] = ("data", "fsdp"),
+    capacity: int | None = None,
 ) -> tuple[jax.Array, dict]:
     """MoE feed-forward, GSPMD formulation.
 
     Dense einsum dispatch/combine; if ``mesh`` has a nontrivial
     ``expert_axis`` the dispatched tensor is constrained to it so XLA
     emits the dispatch/return all_to_all pair over ICI.
+
+    ``capacity`` overrides the per-group slot count derived from this
+    call's token count — decode chunks pass the TRAINING group's value
+    to pin training-identical drop decisions (inference/decode.py).
     """
     B, S, d = x.shape
     E = w_up.shape[0]
-    capacity = expert_capacity(S, E, top_k, capacity_factor)
+    if capacity is None:
+        capacity = expert_capacity(S, E, top_k, capacity_factor)
     combine, dispatch, metrics = top_k_routing(router_logits, top_k, capacity)
 
     compute_dtype = x.dtype

@@ -334,8 +334,10 @@ def onef_oneb_grads(
     Implementation: the exact ``V=1`` case of
     :func:`onef_oneb_grads_interleaved` — with one chunk per device the
     interleaved tick/ring algebra reduces line-for-line to the classic
-    1F1B lockstep (j = t - 2S + 1 + s, ring 2S-1), so ONE scheduler
-    carries both proofs.
+    1F1B lockstep (fwd(m) at tick ``m + s``, bwd(m) at
+    ``m + 2S - 1 - s``, stash ring ``2S - 1``), so ONE scheduler carries
+    both proofs.  Trajectory parity with the AD-GPipe path is pinned in
+    tests/test_pipeline.py.
     """
     wrapped = jax.tree.map(lambda p: p[None], stage_params)
     dparams, dmbs = onef_oneb_grads_interleaved(
@@ -344,263 +346,6 @@ def onef_oneb_grads(
         n_stages=n_stages, virtual=1, axis_name=axis_name,
     )
     return jax.tree.map(lambda p: p.squeeze(0), dparams), dmbs
-
-
-def spmd_pipeline_interleaved(
-    stage_fn: Callable[[Any, jax.Array, jax.Array, jax.Array], jax.Array],
-    stage_params: Any,
-    microbatches: jax.Array,
-    *,
-    n_stages: int,
-    virtual: int,
-    axis_name: str = "pipe",
-    schedule: str = "cond",
-) -> jax.Array:
-    """Megatron interleaved forward: V virtual stages per device.
-
-    Must run inside `shard_map` manual over ``axis_name``.
-    ``stage_params`` leaves are ``[V, C, ...]`` per device (the global
-    ``[V, S, C]`` view sharded on dim 1); ``stage_fn(chunk_params, x,
-    mb_idx, v_idx)`` applies one C-layer chunk.
-
-    Chunk q = v*S + s lives on device s = q % S — so the chain q -> q+1
-    is exactly the ring hop i -> i+1, except the wrap S-1 -> 0 advances
-    the virtual index, and v=0 on device 0 ingests fresh microbatches.
-    Device s's k-th chunk execution (at tick t = s + k) handles::
-
-        v = (k // S) % V
-        m = (k // (S*V)) * S + k % S        (requires M % S == 0)
-
-    This order satisfies both dependencies tick-tight: the same-(v,m)
-    producer on device s-1 finished at t-1, and device 0's (v,m) needs
-    (v-1,m) from device S-1, which finished at t-1 as well (k differs by
-    exactly S).  ``M*V + S - 1`` ticks of one C-layer chunk each.
-    """
-    if schedule not in ("cond", "dense"):
-        raise ValueError(f"unknown pipeline schedule {schedule!r}")
-    S, V = n_stages, virtual
-    M = microbatches.shape[0]
-    if M % S:
-        raise ValueError(
-            f"interleaved schedule needs microbatches % stages == 0 "
-            f"(Megatron grouping); got M={M}, S={S}"
-        )
-    stage = jax.lax.axis_index(axis_name)
-    microbatches = _to_varying(microbatches, axis_name)
-
-    act0 = jnp.zeros_like(microbatches[0])
-    outputs0 = jnp.zeros_like(microbatches)
-    perm = [(i, (i + 1) % S) for i in range(S)]
-    T = M * V + S - 1
-
-    def body(carry, t):
-        act, outputs = carry
-        k = t - stage  # this device's chunk-execution index
-        work = jnp.logical_and(k >= 0, k < M * V)
-        kc = jnp.clip(k, 0, M * V - 1)
-        v = (kc // S) % V
-        m = (kc // (S * V)) * S + kc % S
-        # v=0 on device 0 ingests microbatch m; everything else takes
-        # the ring activation (see the tick-tightness argument above)
-        inp = jnp.where(
-            jnp.logical_and(stage == 0, v == 0),
-            jax.lax.dynamic_index_in_dim(microbatches, m, 0, keepdims=False),
-            act,
-        )
-        chunk_params = jax.tree.map(
-            lambda p: jax.lax.dynamic_index_in_dim(p, v, 0, keepdims=False),
-            stage_params,
-        )
-        if schedule == "cond":
-            out = jax.lax.cond(
-                work,
-                lambda a: stage_fn(chunk_params, a, m, v),
-                lambda a: a,
-                inp,
-            )
-        else:
-            out = stage_fn(chunk_params, inp, m, v)
-        # the chain's last chunk (v = V-1 on device S-1) completes m
-        is_done = jnp.logical_and(
-            jnp.logical_and(stage == S - 1, v == V - 1), work
-        )
-        cur = jax.lax.dynamic_index_in_dim(outputs, m, 0, keepdims=False)
-        outputs = jax.lax.dynamic_update_index_in_dim(
-            outputs, jnp.where(is_done, out, cur), m, 0
-        )
-        nxt = jax.lax.ppermute(out, axis_name, perm)
-        return (nxt, outputs), None
-
-    (_, outputs), _ = jax.lax.scan(body, (act0, outputs0), jnp.arange(T))
-    masked = jnp.where(stage == S - 1, outputs, jnp.zeros_like(outputs))
-    return jax.lax.psum(masked.astype(jnp.float32), axis_name)
-
-
-# ---------------------------------------------------------------------------
-# 1F1B: memory-bounded backward schedule
-# ---------------------------------------------------------------------------
-
-
-def onef_oneb_grads(
-    stage_fn: Callable[[Any, jax.Array, jax.Array], jax.Array],
-    stage_params: Any,
-    microbatches: jax.Array,
-    cotangents: jax.Array,
-    *,
-    n_stages: int,
-    axis_name: str = "pipe",
-) -> tuple[Any, jax.Array]:
-    """Hand-scheduled 1F1B-style combined forward+backward pass.
-
-    Runs inside the same partial-manual ``shard_map`` region as
-    :func:`spmd_pipeline`; returns ``(param_grads, input_cotangents)``
-    for the whole trunk given output ``cotangents`` of shape
-    ``[M, mb, ...]``.
-
-    Why a hand-written backward at all: reverse-mode AD through the GPipe
-    scan stashes one stage-input per iteration — ``M + S - 1`` live
-    activations — and (jax 0.9) refuses `lax.cond` in the differentiated
-    path when branches carry different residuals (dropout).  This
-    schedule is not differentiated — each backward tick recomputes its
-    stage forward from a stashed input and applies the cotangent with an
-    explicit ``jax.vjp`` — so both limits disappear:
-
-    - live stage inputs are a ring buffer of ``2S - 1`` slots (the
-      lockstep in-flight bound), independent of M;
-    - bubbles skip compute via ``lax.cond`` even with dropout on.
-
-    Lockstep schedule over ``T = M + 2S - 1`` ticks: stage ``s`` runs
-    fwd(m) at tick ``t = m + s`` (the GPipe wavefront) and bwd(m) at
-    ``t = m + 2S - 1 - s`` — one tick after the cotangent for ``m``
-    leaves stage ``s+1``, riding a reverse ``ppermute`` ring.  A stash
-    entry lives ``2(S - s) - 1 <= 2S - 1`` ticks, so indexing the ring
-    by ``m mod (2S-1)`` never collides — PROVIDED each tick reads its
-    backward stash entry before the forward slot writes (at stage 0 the
-    two land on the same slot in the same tick; see the ordering note in
-    ``tick``).
-
-    FLOP accounting, in forward-units (bwd ~= 2 fwd): this pass runs the
-    forward wavefront (to regenerate inter-stage activations and
-    stashes) + per-tick vjp recompute + backward = 4 units, on top of
-    the primal forward the custom_vjp wrapper already ran = **5 units
-    total, vs 4 for AD-GPipe with the remat-everything policy** — one
-    extra forward (~25% more step FLOPs) is the price of the
-    M-independent memory bound.  Worth it exactly when M must be large
-    (deep pipelines want M >> S to kill the bubble fraction) and
-    activations, not FLOPs, are the binding constraint.
-    """
-    S = n_stages
-    M = microbatches.shape[0]
-    B = 2 * S - 1  # stash ring size: max in-flight per stage
-    stage = jax.lax.axis_index(axis_name)
-
-    microbatches = _to_varying(microbatches, axis_name)
-    cotangents = _to_varying(cotangents, axis_name)
-
-    act0 = jnp.zeros_like(microbatches[0])
-    cot0 = jnp.zeros_like(cotangents[0])
-    # carries must be device-varying along the pipe axis like the data
-    # they are updated with (scan carry types are checked structurally)
-    stash0 = _to_varying(
-        jnp.zeros((B,) + act0.shape, act0.dtype), axis_name
-    )
-    # fp32 grad accumulators (cast to the param dtype on exit);
-    # stage_params is varying along pipe, so the accumulators must be too
-    dparams0 = jax.tree.map(
-        lambda p: _to_varying(jnp.zeros(p.shape, jnp.float32), axis_name),
-        stage_params,
-    )
-    dmbs0 = jnp.zeros_like(microbatches)
-    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
-    bwd_perm = [(i, (i - 1) % S) for i in range(S)]
-
-    def tick(carry, t):
-        act, cot, stash, dparams, dmbs = carry
-
-        # ---- backward stash read FIRST ----
-        # At stage 0 the forward wavefront writes microbatch t into slot
-        # t % B in the same tick the backward reads microbatch t - B from
-        # the SAME slot (their index difference is exactly B = 2S-1).
-        # Reading before writing keeps the ring size at the 2S-1 lifetime
-        # bound; read-after-write here silently corrupts stage-0
-        # gradients whenever M > S.
-        mb_i = t - (2 * S - 1) + stage
-        work_b = jnp.logical_and(mb_i >= 0, mb_i < M)
-        mb_c = jnp.clip(mb_i, 0, M - 1)
-        x0 = jax.lax.dynamic_index_in_dim(stash, mb_c % B, 0, keepdims=False)
-
-        # ---- forward slot (the GPipe wavefront) ----
-        mf = jnp.clip(t - stage, 0, M - 1)
-        inp = jnp.where(
-            stage == 0,
-            jax.lax.dynamic_index_in_dim(
-                microbatches, jnp.clip(t, 0, M - 1), 0, keepdims=False
-            ),
-            act,
-        )
-        work_f = jnp.logical_and(t - stage >= 0, t - stage < M)
-        y = jax.lax.cond(
-            work_f, lambda a: stage_fn(stage_params, a, mf), lambda a: a, inp
-        )
-        # stash the stage INPUT for the recompute at this microbatch's
-        # backward tick
-        slot_f = mf % B
-        old = jax.lax.dynamic_index_in_dim(stash, slot_f, 0, keepdims=False)
-        stash = jax.lax.dynamic_update_index_in_dim(
-            stash, jnp.where(work_f, inp, old), slot_f, 0
-        )
-
-        # ---- backward slot ----
-        g_in = jnp.where(
-            stage == S - 1,
-            jax.lax.dynamic_index_in_dim(cotangents, mb_c, 0, keepdims=False),
-            cot,
-        )
-
-        def do_bwd(operand):
-            x0, g = operand
-            _, vjp_fn = jax.vjp(
-                lambda p, xx: stage_fn(p, xx, mb_c), stage_params, x0
-            )
-            dp, dx = vjp_fn(g)
-            return jax.tree.map(
-                lambda a: a.astype(jnp.float32), dp
-            ), dx.astype(jnp.float32)
-
-        def no_bwd(operand):
-            _, g = operand
-            return jax.tree.map(
-                lambda p: _to_varying(
-                    jnp.zeros(p.shape, jnp.float32), axis_name
-                ),
-                stage_params,
-            ), g.astype(jnp.float32)
-
-        dp, dx = jax.lax.cond(work_b, do_bwd, no_bwd, (x0, g_in))
-        dparams = jax.tree.map(jnp.add, dparams, dp)
-        # stage 0's dx is the trunk-input cotangent for microbatch mb_i
-        store = jnp.logical_and(stage == 0, work_b)
-        cur = jax.lax.dynamic_index_in_dim(dmbs, mb_c, 0, keepdims=False)
-        dmbs = jax.lax.dynamic_update_index_in_dim(
-            dmbs, jnp.where(store, dx.astype(dmbs.dtype), cur), mb_c, 0
-        )
-
-        # activation hops forward, cotangent hops backward
-        act = jax.lax.ppermute(y, axis_name, fwd_perm)
-        cot = jax.lax.ppermute(dx, axis_name, bwd_perm)
-        return (act, cot, stash, dparams, dmbs), None
-
-    (_, _, _, dparams, dmbs), _ = jax.lax.scan(
-        tick, (act0, cot0, stash0, dparams0, dmbs0),
-        jnp.arange(M + 2 * S - 1),
-    )
-    dparams = jax.tree.map(
-        lambda g, p: g.astype(p.dtype), dparams, stage_params
-    )
-    # only stage 0 wrote real input cotangents; replicate along pipe (fp32
-    # through the region boundary, same rationale as spmd_pipeline)
-    masked = jnp.where(stage == 0, dmbs, jnp.zeros_like(dmbs))
-    return dparams, jax.lax.psum(masked, axis_name)
 
 
 def onef_oneb_grads_interleaved(

@@ -73,6 +73,17 @@ TRANSFORMER_RULES: tuple[Rule, ...] = (
     Rule(r"(lm_head|output_proj|unembed)/kernel", (None, "tensor")),
     # biases of column-split layers follow the split output dim
     Rule(r"(q_proj|k_proj|v_proj|qkv|up_proj|gate_proj|fc1|wi|w1|w3)/bias", ("tensor",)),
+    # torch-bridge naming (models/torch_bridge.py): MHA weights keep the
+    # TORCH [out, in] layout — packed qkv `in_w` [3d, d] column-splits
+    # dim 0, `out_w` [d, d] row-splits its contraction (input) dim 1 —
+    # while Linear kernels are transposed to flax [in, out] layout
+    # (lin1 fan-out -> column, lin2 fan-in -> row).
+    Rule(r"(sa|ca)\.in_w$", ("tensor", None)),
+    Rule(r"(sa|ca)\.in_b$", ("tensor",)),
+    Rule(r"(sa|ca)\.out_w$", (None, "tensor")),
+    Rule(r"lin1\.kernel$", (None, "tensor")),
+    Rule(r"lin1\.bias$", ("tensor",)),
+    Rule(r"lin2\.kernel$", ("tensor", None)),
     # norms / scalars replicated
     Rule(r"(norm|ln|layernorm|rmsnorm|scale)", ()),
 )
@@ -314,7 +325,35 @@ def param_spec_tree(
             spec = _fsdp_spec(shape, degrees, existing=spec, fsdp_axes=fsdp_axes)
         return spec if spec is not None else P()
 
-    return jax.tree_util.tree_map_with_path(assign, abstract_params)
+    tree = jax.tree_util.tree_map_with_path(assign, abstract_params)
+    if use_tp and not any(
+        _spec_uses_axis(s, "tensor")
+        for s in jax.tree.leaves(tree, is_leaf=lambda x: isinstance(x, P))
+    ):
+        import warnings
+
+        warnings.warn(
+            f"strategy {strategy!r} requests tensor parallelism but ZERO "
+            "parameters matched a tensor rule: the 'tensor' mesh axis "
+            "will sit unused and every parameter is replicated across "
+            "it (silent tp degradation).  Models with nonstandard param "
+            "names — e.g. hand-written modules or from_torch bridges of "
+            "custom architectures — need custom rules: pass "
+            "AutoDistribute(..., rules=(planner.Rule(r'my_proj/kernel', "
+            "(None, 'tensor')), ...)) mapping your param paths to "
+            "column/row splits (see planner.TRANSFORMER_RULES).",
+            stacklevel=2,
+        )
+    return tree
+
+
+def _spec_uses_axis(spec: P, axis: str) -> bool:
+    for entry in spec:
+        if entry == axis:
+            return True
+        if isinstance(entry, (tuple, list)) and axis in entry:
+            return True
+    return False
 
 
 def batch_partition_spec(mesh: Mesh) -> P:

@@ -116,11 +116,30 @@ def adamw_cosine(
     tx = optax.adamw(
         warmup_cosine(peak_lr, total_steps, warmup_steps=warmup_steps),
         b1=b1, b2=b2, weight_decay=weight_decay,
-        # the GPT recipe decays matrices only: norm scales/biases and
-        # other vectors train without decay (torch reference analog:
-        # the no_decay param-group split)
-        mask=lambda params: jax.tree.map(lambda p: p.ndim >= 2, params),
+        mask=decay_mask,
     )
     if grad_clip:
         tx = optax.chain(optax.clip_by_global_norm(grad_clip), tx)
     return tx
+
+
+def decay_mask(params: Any) -> Any:
+    """Weight-decay mask: the GPT no_decay param-group analog.
+
+    Decays matrices only, identified by PATH, not ndim: the framework's
+    DecoderLM stores layer params nn.scan-stacked with a leading ``[L]``
+    axis, so a per-layer norm scale is ``[L, d]`` — ndim 2 — and an
+    ndim-based mask (the round-4 advisor finding) silently weight-decays
+    every stacked norm scale/bias.  A leaf named ``scale``/``bias``
+    (flax's LayerNorm/Dense naming) is never decayed regardless of rank;
+    everything else decays iff it has a non-layer matrix dimension left
+    (ndim >= 2 unstacked semantics are preserved for unstacked trees).
+    """
+
+    def keep(kp, p):
+        last = path_str(kp).rsplit("/", 1)[-1]
+        if last in ("bias", "scale"):
+            return False
+        return p.ndim >= 2
+
+    return jax.tree_util.tree_map_with_path(keep, params)
