@@ -479,9 +479,25 @@ def _attention_block_sweep(args, heads, hd, on_tpu):
     blocks = (256, 512, 1024, 2048)
     if "blocks" in args:  # e.g. blocks=384,512,640,768 — finer grids
         blocks = tuple(int(x) for x in str(args["blocks"]).split(","))
+    # 1024 is the GPT-2 headline seq (off by default: the r4 sweep only
+    # covered 2k+); 32768 is the single-chip long-context datapoint
+    all_rows = ((1024, 8), (2048, 4), (8192, 1), (16384, 1), (32768, 1))
+    want = {2048, 8192, 16384}
+    if "seqs" in args:  # e.g. seqs=8192 — focus the grid on one length
+        want = {int(x) for x in str(args["seqs"]).split(",")}
+    unknown = want - {r[0] for r in all_rows}
+    if unknown:  # a typo'd seq must not silently yield a 0.0 record
+        return {
+            "metric": "flash_block_sweep_bad_seqs",
+            "value": 0.0, "unit": "none", "vs_baseline": 0.0,
+            "extra": {"error": f"seqs= not in the sweep table: "
+                               f"{sorted(unknown)}; known: "
+                               f"{sorted(r[0] for r in all_rows)}"},
+        }
+    seq_rows = tuple(r for r in all_rows if r[0] in want)
     rows = []
     best = {}
-    for seq, batch in ((2048, 4), (8192, 1), (16384, 1)):
+    for seq, batch in seq_rows:
         key = jax.random.key(seq)
         kq, kk, kv = jax.random.split(key, 3)
         shape = (batch, seq, heads, hd)
@@ -1136,27 +1152,47 @@ def _probe_backend(timeout_s: int = 300) -> str | None:
     return probe_backend(timeout_s)
 
 
+def _canonical_argv(mode: str) -> bool:
+    """True when argv is the mode's headline invocation — nothing but
+    ``mode=`` plus the mode's allowlisted extras.  Guards BOTH sides of
+    the last-good cache: a debug override (seq=512, sweep=1, ...) must
+    neither be SAVED as the mode's headline nor REPLAYED as the result
+    of an invocation that asked for something else (round-5 review)."""
+    extras = {item for item in sys.argv[1:] if not item.startswith("mode=")}
+    return extras == set(_CANONICAL_EXTRA.get(mode, ()))
+
+
+# Per-mode extra argv items that still count as the headline invocation.
+# decode's committed capture IS the MoE-routed one (BENCH_NOTES round 5);
+# plain dense decode is a different metric and must not take the slot.
+_CANONICAL_EXTRA = {"decode": ("model=moe",)}
+
+
 def main():
     args = parse_args()
     err = _probe_backend()
     cpu_ok = dict(MODE_SIM_DEVICES)
     cpu_ok["memfit"] = int(args.get("devices", cpu_ok["memfit"]))
-    if err is not None and args["mode"] in cpu_ok:
-        # These modes run entirely on the CPU sim anyway; a dead TPU
-        # tunnel must not block them — re-exec straight onto the device
-        # count the mode needs (skipping the doomed axon init AND the
-        # mode's own nested re-exec).  Each mode labels CPU-sim records
-        # as such, so sim numbers can't masquerade as TPU ones.
-        _cpu_sim_reexec(cpu_ok[args["mode"]],
-                        f"TPU backend unreachable ({err}); "
-                        f"mode={args['mode']} runs on the CPU sim")
     if err is not None:
+        # A committed on-TPU measurement beats a CPU-sim rerun as the
+        # honest answer for a canonical invocation (sim perf numbers
+        # measure dispatch overhead, not the chip) — check the stale
+        # cache FIRST, then fall back to the sim for the modes whose
+        # results are backend-independent (memfit's XLA memory analysis,
+        # pipeline/collectives semantics...), each labeled as sim.
+        if not (_canonical_argv(args["mode"])
+                and _load_last_good().get(args["mode"])) \
+                and args["mode"] in cpu_ok:
+            _cpu_sim_reexec(cpu_ok[args["mode"]],
+                            f"TPU backend unreachable ({err}); "
+                            f"mode={args['mode']} runs on the CPU sim")
         # The metric is unmeasurable THIS run.  Emit the most recent
         # committed TPU measurement for this mode, explicitly labeled
         # stale, so the driver scoreboard reflects the framework rather
         # than the tunnel; 0.0 only when no committed number exists.
         log(f"TPU backend unreachable: {err}")
-        last = _load_last_good().get(args["mode"])
+        last = (_load_last_good().get(args["mode"])
+                if _canonical_argv(args["mode"]) else None)
         if last:
             rec = dict(last["result"])
             extra = dict(rec.get("extra") or {})
@@ -1198,13 +1234,13 @@ def main():
         jax.default_backend() != "cpu"
         # keep "last good" actually good: never save failed/empty runs
         # (value 0.0 / recorded error), and only save CANONICAL
-        # invocations (argv carries nothing but mode=) — a debug
-        # override like seq=512 batch=1, or a sweep=1 variant with a
-        # different metric, would otherwise be replayed verbatim as the
-        # mode's headline by every tunnel-down round
+        # invocations (_canonical_argv) — a debug override like seq=512
+        # batch=1, or a sweep=1 variant with a different metric, would
+        # otherwise be replayed verbatim as the mode's headline by every
+        # tunnel-down round
         and result.get("value", 0) > 0
         and "error" not in (result.get("extra") or {})
-        and all(item.startswith("mode=") for item in sys.argv[1:])
+        and _canonical_argv(args["mode"])
     ):
         _save_last_good(args["mode"], result,
                         jax.devices()[0].device_kind)

@@ -73,3 +73,60 @@ def test_repo_last_good_is_seeded():
     data = bench._load_last_good()
     assert "gpt2" in data
     assert data["gpt2"]["result"]["value"] > 0
+
+def test_noncanonical_argv_never_replays_last_good(
+        last_good, monkeypatch, capsys):
+    # `mode=attention sweep=1` must not be answered with the committed
+    # HEADLINE attention record — the caller asked for a different
+    # metric (round-5 review)
+    last_good.write_text(json.dumps({
+        "attention": {"result": {"metric": "flash_attention_speedup",
+                                 "value": 14.22, "unit": "x",
+                                 "vs_baseline": 0.81, "extra": {}},
+                      "measured_utc": "2026-07-31T01:27:55Z",
+                      "device_kind": "TPU v5 lite"},
+    }))
+    rec = _run_main(monkeypatch, capsys,
+                    argv=["bench.py", "mode=attention", "sweep=1"])
+    assert rec["value"] == 0.0
+    assert rec["metric"] == "attention_unmeasurable_backend_down"
+
+
+def test_canonical_extra_allows_decode_moe(last_good, monkeypatch, capsys):
+    # decode's headline IS the MoE-routed capture: `mode=decode
+    # model=moe` counts as canonical for both save and replay, and wins
+    # over the CPU-sim re-exec when a committed TPU number exists
+    last_good.write_text(json.dumps({
+        "decode": {"result": {"metric": "moe_small_decode_tokens_per_s",
+                              "value": 1651.8, "unit": "tokens/s",
+                              "vs_baseline": 1.0, "extra": {}},
+                   "measured_utc": "2026-07-31T01:26:52Z",
+                   "device_kind": "TPU v5 lite"},
+    }))
+    rec = _run_main(monkeypatch, capsys,
+                    argv=["bench.py", "mode=decode", "model=moe"])
+    assert rec["value"] == pytest.approx(1651.8)
+    assert rec["stale"] is True
+
+
+def test_bad_sweep_seqs_is_loud():
+    rec = bench._attention_block_sweep(
+        {"sweep": 1, "seqs": "4096"}, heads=16, hd=128, on_tpu=True)
+    assert rec["metric"] == "flash_block_sweep_bad_seqs"
+    assert "4096" in rec["extra"]["error"]
+
+
+def test_dense_decode_does_not_share_moe_slot(last_good, monkeypatch, capsys):
+    # extras are REQUIRED, not merely permitted: plain dense `mode=decode`
+    # is NOT decode's canonical invocation, so it must not replay (or
+    # ever save over) the MoE-routed headline slot — it falls through to
+    # the CPU-sim re-exec instead (round-5 review, second pass)
+    monkeypatch.setattr(bench.sys, "argv", ["bench.py", "mode=decode"])
+    assert not bench._canonical_argv("decode")
+    monkeypatch.setattr(
+        bench.sys, "argv", ["bench.py", "mode=decode", "model=moe"])
+    assert bench._canonical_argv("decode")
+    monkeypatch.setattr(bench.sys, "argv", ["bench.py"])
+    assert bench._canonical_argv("gpt2")
+    monkeypatch.setattr(bench.sys, "argv", ["bench.py", "mode=gpt2"])
+    assert bench._canonical_argv("gpt2")

@@ -25,19 +25,36 @@ def _ensure_installed() -> None:
         importlib.metadata.distribution("tadnn-tpu")
         return
     except importlib.metadata.PackageNotFoundError:
+        pass
+    # Serialize concurrent installers (xdist workers, parallel pytest
+    # invocations): N racing `pip install -e` processes writing the same
+    # dist-info corrupt each other (round-5 review).
+    import fcntl
+
+    with open(os.path.join(_REPO_ROOT, ".pip_install.lock"), "w") as lf:
+        fcntl.flock(lf, fcntl.LOCK_EX)
+        try:
+            importlib.metadata.distribution("tadnn-tpu")
+            return  # another holder installed it while we waited
+        except importlib.metadata.PackageNotFoundError:
+            pass
         proc = subprocess.run(
             [sys.executable, "-m", "pip", "install", "-e", _REPO_ROOT,
              "--no-deps", "--no-build-isolation"],
             capture_output=True, text=True, timeout=300,
         )
-        # a broken pyproject must FAIL the module, not skip it
+        # a broken pyproject must FAIL the tests, not skip them
         assert proc.returncode == 0, (
             "editable self-install failed (broken pyproject?):\n"
             + proc.stderr[-2000:]
         )
 
 
-_ensure_installed()
+@pytest.fixture(scope="module", autouse=True)
+def _installed():
+    # fixture, not import side effect: a failed install reports as a test
+    # error on this module instead of a collection error for the run
+    _ensure_installed()
 
 
 def _dist():
