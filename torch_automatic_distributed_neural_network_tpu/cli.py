@@ -114,19 +114,29 @@ def cmd_fit(args: argparse.Namespace) -> int:
     import optax
 
     from . import AutoDistribute
-    from .models import GPT2, Llama, MoE
+    from .models import GPT2, Bert, Llama, MoE
     from .training import (
         blockwise_next_token_loss,
+        masked_lm_loss,
         moe_next_token_loss,
         next_token_loss,
     )
 
-    family = {"gpt2": GPT2, "llama": Llama, "moe": MoE}[args.family]
-    size = args.size or {"gpt2": "1p3b", "llama": "8b", "moe": "test"}[
-        args.family
-    ]
+    family = {"gpt2": GPT2, "llama": Llama, "moe": MoE,
+              "bert": Bert}[args.family]
+    size = args.size or {"gpt2": "1p3b", "llama": "8b", "moe": "test",
+                         "bert": "large"}[args.family]
     model = family(size, max_seq_len=args.seq)
-    if args.loss == "blockwise":
+    if args.family == "bert":
+        if args.loss == "blockwise":
+            # blockwise CE is a CAUSAL next-token loss; silently running
+            # it on the bidirectional encoder would fit-report a graph no
+            # real BERT config trains (round-5 review)
+            print(json.dumps({"error": "--loss blockwise is next-token "
+                              "(causal); bert uses masked LM"}))
+            return 1
+        loss = masked_lm_loss
+    elif args.loss == "blockwise":
         loss = blockwise_next_token_loss()
     else:
         loss = (moe_next_token_loss if args.family == "moe"
@@ -138,7 +148,11 @@ def cmd_fit(args: argparse.Namespace) -> int:
         strategy=args.strategy,
         precision=args.precision,
     )
-    sample = {"tokens": np.zeros((args.batch, args.seq + 1), np.int32)}
+    if args.family == "bert":
+        sample = {"input_ids": np.zeros((args.batch, args.seq), np.int32),
+                  "labels": np.full((args.batch, args.seq), -100, np.int32)}
+    else:
+        sample = {"tokens": np.zeros((args.batch, args.seq + 1), np.int32)}
     if args.strategy == "search":
         ad.build_plan(jax.random.key(0), sample)
         entries = ad.search_report or [
@@ -229,10 +243,10 @@ def main(argv: list[str] | None = None) -> int:
              "escalation ladder and reports every candidate",
     )
     p.add_argument("--family", default="gpt2",
-                   choices=("gpt2", "llama", "moe"))
+                   choices=("gpt2", "llama", "moe", "bert"))
     p.add_argument("--size", default=None,
                    help="model size preset; default per family "
-                        "(gpt2: 1p3b, llama: 8b, moe: test)")
+                        "(gpt2: 1p3b, llama: 8b, moe: test, bert: large)")
     p.add_argument("--seq", type=int, default=1024)
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--strategy", default="search")

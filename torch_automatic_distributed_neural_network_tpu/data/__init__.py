@@ -12,7 +12,11 @@ from .arrays import (
 )
 from .loader import TokenFileDataset, shard_for_host, write_token_file
 from .text import ByteTokenizer, load_tokenizer, tokenize_file
-from .synthetic import SyntheticClassification, SyntheticLM
+from .synthetic import (
+    SyntheticClassification,
+    SyntheticLM,
+    SyntheticMLM,
+)
 from .torch_adapter import TorchDatasetAdapter, TorchLoaderAdapter
 
 __all__ = [
@@ -24,6 +28,7 @@ __all__ = [
     "load_seq2seq",
     "SyntheticClassification",
     "SyntheticLM",
+    "SyntheticMLM",
     "TokenFileDataset",
     "shard_for_host",
     "write_token_file",

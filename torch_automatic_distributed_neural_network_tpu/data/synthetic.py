@@ -120,3 +120,55 @@ class SyntheticSeq2Seq:
         while True:
             yield self.batch(step)
             step += 1
+
+
+class SyntheticMLM:
+    """BERT-shaped masked-LM batches: the SyntheticLM cumsum stream with
+    15% of positions masked out (80% [MASK], 10% random, 10% kept — the
+    BERT recipe), labels carrying the original token at masked positions
+    and -100 (ignore) elsewhere."""
+
+    step_indexed = True
+
+    def __init__(
+        self,
+        vocab_size: int = 30522,
+        seq_len: int = 128,
+        batch_size: int = 8,
+        mask_token: int = 103,  # BERT's [MASK]
+        mask_rate: float = 0.15,
+        seed: int = 0,
+    ):
+        self.vocab_size = vocab_size
+        self.seq_len = seq_len
+        self.batch_size = batch_size
+        self.mask_token = mask_token
+        self.mask_rate = mask_rate
+        self.seed = seed
+
+    def batch(self, step: int) -> dict:
+        # same learnable cumsum stream as SyntheticLM (ONE recipe — the
+        # LM and MLM streams must not silently diverge), masked on top
+        toks = SyntheticLM(
+            self.vocab_size, self.seq_len, self.batch_size, self.seed
+        ).batch(step)["input_ids"]
+        rng = np.random.RandomState(self.seed + step + 1)
+        pick = rng.random(toks.shape) < self.mask_rate
+        labels = np.where(pick, toks, -100)
+        kind = rng.random(toks.shape)
+        inputs = toks.copy()
+        inputs[pick & (kind < 0.8)] = self.mask_token
+        rand_pos = pick & (kind >= 0.8) & (kind < 0.9)
+        inputs[rand_pos] = rng.randint(
+            0, self.vocab_size, size=int(rand_pos.sum())
+        )
+        return {
+            "input_ids": inputs.astype(np.int32),
+            "labels": labels.astype(np.int32),
+        }
+
+    def __iter__(self):
+        step = 0
+        while True:
+            yield self.batch(step)
+            step += 1

@@ -1,8 +1,10 @@
 """Model zoo mirroring the reference's example models (SURVEY.md C11/C12)."""
 
+from .bert import Bert, BertClassifier, BertEncoder, bert_config
 from .gpt2 import GPT2, gpt2_config
 from .import_hf import (
     export_hf_gpt2,
+    import_hf_bert,
     export_hf_llama,
     export_hf_mixtral,
     import_hf_gpt2,
@@ -19,6 +21,11 @@ from .transformer_mt import Seq2SeqTransformer, TransformerMT
 
 __all__ = [
     "MLP",
+    "Bert",
+    "BertClassifier",
+    "BertEncoder",
+    "bert_config",
+    "import_hf_bert",
     "GPT2",
     "gpt2_config",
     "import_hf_gpt2",

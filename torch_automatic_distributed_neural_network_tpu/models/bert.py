@@ -1,0 +1,128 @@
+"""BERT family: encoder-only transformers on the shared backbone
+(component C12 — the reference's transformer example set is
+decoder/encoder-decoder; the encoder-only family completes the zoo).
+
+Architecturally BERT is the shared core with the other switches thrown:
+bidirectional attention (``causal=False``), post-norm residual order,
+LayerNorm'd embeddings, segment (token-type) embeddings, exact-erf GELU,
+and no final norm (each post-norm layer already ends normalized).  All
+TPU-first properties of the core carry over unchanged — ``nn.scan`` over
+layers, per-layer remat, Megatron-SP activation sharding, and parameter
+names (q_proj/up_proj/...) the planner's TP rules anchor on, so
+``strategy='tp'/'fsdp'/'tp_fsdp'`` work on BERT with zero new rules.
+
+The MLM head follows the HF ``BertForMaskedLM`` layout (dense d->d +
+exact gelu + LayerNorm, then the tied-embedding decoder plus a vocab
+bias) so ``import_hf_bert`` achieves logits parity — pinned against
+``transformers`` in tests/test_bert.py.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .transformer_core import (
+    DecoderLayer,
+    TransformerConfig,
+    apply_decoder_backbone,
+    make_norm,
+)
+
+
+def bert_config(size: str = "base", **overrides) -> TransformerConfig:
+    presets = {
+        # name: (n_layers, d_model, n_heads)
+        "base": (12, 768, 12),    # 110M
+        "large": (24, 1024, 16),  # 340M
+        # tiny config for tests / CPU sim
+        "test": (2, 128, 4),
+    }
+    L, d, h = presets[size]
+    base = dict(
+        vocab_size=30522,
+        d_model=d,
+        n_layers=L,
+        n_heads=h,
+        max_seq_len=512,
+        norm="layernorm",
+        act="gelu_exact",
+        pos="learned",
+        causal=False,
+        norm_order="post",
+        embed_norm=True,
+        final_norm=False,
+        type_vocab_size=2,
+        tie_embeddings=True,
+    )
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def padding_mask(attn_mask) -> jnp.ndarray | None:
+    """[B, S] 1/0 (or bool) keep-mask -> the attention() convention
+    ``[B, 1, 1, K]`` (True = attend); None passes through."""
+    if attn_mask is None:
+        return None
+    return attn_mask.astype(bool)[:, None, None, :]
+
+
+class BertEncoder(nn.Module):
+    """Encoder-only LM with the HF-layout masked-LM head.
+
+    ``__call__(tokens, segment_ids=None, attn_mask=None)`` -> fp32 MLM
+    logits ``[B, S, V]``; ``return_features=True`` returns the final
+    hidden states instead (for classification heads / sentence
+    embeddings).  ``attn_mask`` is a ``[B, S]`` keep-mask over keys
+    (padding), broadcast to every query position.
+    """
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, segment_ids=None, attn_mask=None,
+                 positions=None, return_features: bool = False):
+        cfg = self.cfg
+
+        def mlm_head(x, embed):
+            # HF BertForMaskedLM: transform (dense + exact gelu + LN),
+            # then the decoder tied to the embedding matrix + vocab bias
+            h = nn.Dense(cfg.d_model, dtype=cfg.dtype, name="mlm_dense")(x)
+            h = nn.gelu(h, approximate=False)
+            h = make_norm(cfg, "mlm_norm")(h)
+            logits = embed.attend(h.astype(jnp.float32))
+            bias = self.param("mlm_bias", nn.initializers.zeros,
+                              (cfg.vocab_size,), jnp.float32)
+            return logits + bias
+
+        out, _ = apply_decoder_backbone(
+            self, cfg, tokens, positions, padding_mask(attn_mask),
+            DecoderLayer, return_features=return_features,
+            segment_ids=segment_ids, head=mlm_head,
+        )
+        return out
+
+
+class BertClassifier(nn.Module):
+    """Sequence classification: [CLS] (first-token) features -> logits.
+
+    Mirrors HF's ``BertForSequenceClassification`` shape minus the NSP
+    pooler tanh (fine-tuning from scratch does not need it): take the
+    first position of the final hidden states and project.
+    """
+
+    cfg: TransformerConfig
+    num_classes: int = 2
+
+    @nn.compact
+    def __call__(self, tokens, segment_ids=None, attn_mask=None):
+        feats = BertEncoder(self.cfg, name="encoder")(
+            tokens, segment_ids, attn_mask, return_features=True
+        )
+        cls = feats[:, 0].astype(jnp.float32)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="classifier")(cls)
+
+
+def Bert(size: str = "base", **overrides) -> BertEncoder:
+    return BertEncoder(bert_config(size, **overrides))

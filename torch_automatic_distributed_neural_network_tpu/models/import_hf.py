@@ -556,3 +556,134 @@ def import_hf_mixtral(
             },
         })
     return MoELM(cfg), c.assemble(layers)
+
+
+def import_hf_bert(
+    model_or_state_dict, *, max_seq_len: int | None = None,
+    n_heads: int | None = None, dtype: Any = None,
+):
+    """HF ``BertForMaskedLM`` / ``BertModel`` -> (our BertEncoder, variables).
+
+    Post-norm order maps 1:1: HF's ``attention.output.LayerNorm`` /
+    ``output.LayerNorm`` (applied after each residual add) are our
+    ``attn_norm`` / ``mlp_norm`` with ``norm_order='post'``; embeddings
+    LayerNorm -> ``embed_norm``; the MLM transform+decoder -> the
+    ``mlm_dense``/``mlm_norm``/``mlm_bias`` head (decoder weights are
+    tied to the word embeddings in both layouts).  Logits parity vs
+    ``transformers`` is pinned in tests/test_bert.py.
+    """
+    from .bert import BertEncoder, bert_config
+
+    sd = _state_dict(model_or_state_dict)
+
+    def g(name):
+        return _get(sd, f"bert.{name}", name)
+
+    wte = g("embeddings.word_embeddings.weight")
+    wpe = g("embeddings.position_embeddings.weight")
+    tte = g("embeddings.token_type_embeddings.weight")
+    vocab, d = wte.shape
+    n_layers = 0
+    while (f"bert.encoder.layer.{n_layers}.attention.self.query.weight"
+           in sd) or (
+           f"encoder.layer.{n_layers}.attention.self.query.weight" in sd):
+        n_layers += 1
+    if n_heads is None:
+        hf_cfg = getattr(model_or_state_dict, "config", None)
+        if hf_cfg is not None and getattr(
+                hf_cfg, "num_attention_heads", None):
+            n_heads = int(hf_cfg.num_attention_heads)
+        else:
+            # a wrong head count splits Q/K/V on the wrong boundary and
+            # produces silently wrong logits — refuse to guess for raw
+            # state_dicts (same policy as import_hf_llama)
+            raise ValueError(
+                "cannot infer the head count from a raw state_dict "
+                "(Q/K/V are per-head fused); pass n_heads= explicitly"
+            )
+    hd = d // n_heads
+    d_ff = g("encoder.layer.0.intermediate.dense.weight").shape[0]
+    cfg = bert_config(
+        "base",
+        vocab_size=vocab,
+        d_model=d,
+        n_layers=n_layers,
+        n_heads=n_heads,
+        d_ff=d_ff,
+        max_seq_len=max_seq_len or wpe.shape[0],
+        type_vocab_size=tte.shape[0],
+        **({"dtype": dtype} if dtype is not None else {}),
+    )
+    layers = []
+    for i in range(n_layers):
+        def L(name):
+            return g(f"encoder.layer.{i}.{name}")
+
+        def ln(name):
+            return {"scale": L(f"{name}.weight"), "bias": L(f"{name}.bias")}
+
+        layers.append({
+            "attn": {
+                "q_proj": {
+                    "kernel": _lin(L("attention.self.query.weight"),
+                                   (n_heads, hd)),
+                    "bias": L("attention.self.query.bias").reshape(
+                        n_heads, hd),
+                },
+                "k_proj": {
+                    "kernel": _lin(L("attention.self.key.weight"),
+                                   (n_heads, hd)),
+                    "bias": L("attention.self.key.bias").reshape(
+                        n_heads, hd),
+                },
+                "v_proj": {
+                    "kernel": _lin(L("attention.self.value.weight"),
+                                   (n_heads, hd)),
+                    "bias": L("attention.self.value.bias").reshape(
+                        n_heads, hd),
+                },
+                "o_proj": {
+                    "kernel": _np(
+                        L("attention.output.dense.weight")
+                    ).T.reshape(n_heads, hd, d),
+                    "bias": L("attention.output.dense.bias"),
+                },
+            },
+            "attn_norm": ln("attention.output.LayerNorm"),
+            "mlp": {
+                "up_proj": {"kernel": _lin(L("intermediate.dense.weight")),
+                            "bias": L("intermediate.dense.bias")},
+                "down_proj": {"kernel": _lin(L("output.dense.weight")),
+                              "bias": L("output.dense.bias")},
+            },
+            "mlp_norm": ln("output.LayerNorm"),
+        })
+    params = {
+        "embed": {"embedding": wte},
+        "pos_embed": wpe,
+        "seg_embed": {"embedding": tte},
+        "embed_norm": {"scale": g("embeddings.LayerNorm.weight"),
+                       "bias": g("embeddings.LayerNorm.bias")},
+        "layers": _stack(layers),
+    }
+    # masked-LM head (absent on a bare BertModel: init to the identity-ish
+    # defaults so features still come out right and MLM can be fine-tuned)
+    if any(k.startswith("cls.predictions") for k in sd):
+        params["mlm_dense"] = {
+            "kernel": _lin(sd["cls.predictions.transform.dense.weight"]),
+            "bias": _np(sd["cls.predictions.transform.dense.bias"]),
+        }
+        params["mlm_norm"] = {
+            "scale": _np(sd["cls.predictions.transform.LayerNorm.weight"]),
+            "bias": _np(sd["cls.predictions.transform.LayerNorm.bias"]),
+        }
+        params["mlm_bias"] = _np(sd["cls.predictions.bias"])
+    else:
+        params["mlm_dense"] = {
+            "kernel": np.eye(d, dtype=np.float32),
+            "bias": np.zeros((d,), np.float32),
+        }
+        params["mlm_norm"] = {"scale": np.ones((d,), np.float32),
+                              "bias": np.zeros((d,), np.float32)}
+        params["mlm_bias"] = np.zeros((vocab,), np.float32)
+    return BertEncoder(cfg), {"params": params}

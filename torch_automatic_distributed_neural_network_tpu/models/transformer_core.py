@@ -39,8 +39,19 @@ class TransformerConfig:
     d_ff: int | None = None  # None -> 4*d_model (gelu) / 8/3*d_model (swiglu)
     max_seq_len: int = 1024
     norm: Literal["layernorm", "rmsnorm"] = "layernorm"
-    act: Literal["gelu", "swiglu"] = "gelu"
+    # 'gelu_exact' is the erf formulation (HF BERT's hidden_act='gelu');
+    # plain 'gelu' is the tanh approximation (GPT-2's gelu_new)
+    act: Literal["gelu", "gelu_exact", "swiglu"] = "gelu"
     pos: Literal["learned", "rope"] = "learned"
+    # False -> bidirectional self-attention: the same backbone serves
+    # encoder-only families (BERT, models/bert.py)
+    causal: bool = True
+    # 'post' = original-transformer/BERT residual order
+    # (norm AFTER the residual add); 'pre' = GPT-2/Llama
+    norm_order: Literal["pre", "post"] = "pre"
+    embed_norm: bool = False  # LayerNorm on embeddings (BERT)
+    final_norm: bool = True  # post-norm stacks end already normalized
+    type_vocab_size: int = 0  # >0 -> segment embeddings (BERT NSP-style)
     tie_embeddings: bool = True
     dropout_rate: float = 0.0
     dtype: Any = jnp.bfloat16  # compute dtype; params stay fp32
@@ -79,8 +90,10 @@ class TransformerConfig:
         attn = d * (self.n_heads * hd) + 2 * d * (self.kv_heads * hd) + (
             self.n_heads * hd) * d
         mlp = (3 if self.act == "swiglu" else 2) * d * f
-        norms = (2 * d) * L + d
+        norms = (2 * d) * L + (d if self.final_norm else 0) + (
+            d if self.embed_norm else 0)
         emb = v * d * (1 if self.tie_embeddings else 2)
+        emb += self.type_vocab_size * d
         pos = self.max_seq_len * d if self.pos == "learned" else 0
         return L * (attn + mlp) + norms + emb + pos
 
@@ -140,7 +153,8 @@ class SelfAttention(nn.Module):
     def __call__(self, x, positions, mask=None):
         q, k, v = self.qkv(x, positions)
         out = attention(
-            q, k, v, causal=True, mask=mask, impl=self.cfg.attention_impl
+            q, k, v, causal=self.cfg.causal, mask=mask,
+            impl=self.cfg.attention_impl,
         )
         return self.out_proj(out)
 
@@ -164,7 +178,8 @@ class MLPBlock(nn.Module):
         if self.cfg.act == "swiglu":
             h = nn.silu(self.gate_proj(x)) * self.up_proj(x)
         else:
-            h = nn.gelu(self.up_proj(x))
+            h = nn.gelu(self.up_proj(x),
+                        approximate=self.cfg.act != "gelu_exact")
         return self.down_proj(h)
 
 
@@ -184,20 +199,29 @@ class DecoderLayer(nn.Module):
         # residual adds run sequence-sharded, and GSPMD materializes the
         # full sequence only inside the attention/MLP matmul regions.
         cfg = self.cfg
+        post = cfg.norm_order == "post"
         x = shard_activations(x)
-        h = make_norm(cfg, "attn_norm")(x)
+        # post-norm (original transformer / BERT): sublayer on the raw
+        # stream, norm AFTER the residual add; pre-norm: norm first
+        h = x if post else make_norm(cfg, "attn_norm")(x)
         h = SelfAttention(cfg, name="attn")(h, positions, mask)
         if cfg.dropout_rate:
             h = nn.Dropout(cfg.dropout_rate, deterministic=not self.has_rng("dropout"))(h)
-        x = shard_activations(x + h)
-        h = make_norm(cfg, "mlp_norm")(x)
+        x = x + h
+        if post:
+            x = make_norm(cfg, "attn_norm")(x)
+        x = shard_activations(x)
+        h = x if post else make_norm(cfg, "mlp_norm")(x)
         h = self.mlp_cls(cfg, name="mlp")(h)
         aux = None
         if isinstance(h, tuple):
             h, aux = h
         if cfg.dropout_rate:
             h = nn.Dropout(cfg.dropout_rate, deterministic=not self.has_rng("dropout"))(h)
-        out = shard_activations(x + h)
+        out = x + h
+        if post:
+            out = make_norm(cfg, "mlp_norm")(out)
+        out = shard_activations(out)
         return out if aux is None else (out, aux)
 
 
@@ -209,6 +233,8 @@ def apply_decoder_backbone(
     mask,
     layer_base: type[nn.Module],
     return_features: bool = False,
+    segment_ids=None,
+    head=None,
 ):
     """Shared decoder body: embed -> (remat'd, scanned) layer stack -> norm
     -> tied/untied head.
@@ -224,6 +250,12 @@ def apply_decoder_backbone(
     temp at large vocab (Llama-3: 128k), and ``training.losses.
     blockwise_next_token_loss`` consumes features + head weights to
     compute the loss without ever materializing it.
+
+    ``segment_ids`` adds BERT-style token-type embeddings (requires
+    ``cfg.type_vocab_size > 0``); ``head`` is an optional callable
+    ``head(features, embed) -> logits`` replacing the default tied /
+    untied LM head — encoder families use it for the MLM transform
+    (models/bert.py) without duplicating the "embed" module name.
     """
     if positions is None:
         positions = jnp.arange(tokens.shape[1])[None, :]
@@ -239,6 +271,15 @@ def apply_decoder_backbone(
             (cfg.max_seq_len, cfg.d_model), jnp.float32,
         )
         x = x + pos_emb[None, : tokens.shape[1]].astype(cfg.dtype)
+    if cfg.type_vocab_size:
+        if segment_ids is None:
+            segment_ids = jnp.zeros_like(tokens)
+        x = x + nn.Embed(
+            cfg.type_vocab_size, cfg.d_model, dtype=cfg.dtype,
+            embedding_init=nn.initializers.normal(0.02), name="seg_embed",
+        )(segment_ids)
+    if cfg.embed_norm:
+        x = make_norm(cfg, "embed_norm")(x)
     x = shard_activations(x)
 
     layer_cls = layer_base
@@ -278,9 +319,12 @@ def apply_decoder_backbone(
                 layer_cls(cfg, name=f"layers_{i}"), x, aux_total
             )
 
-    x = make_norm(cfg, "final_norm")(x)
+    if cfg.final_norm:
+        x = make_norm(cfg, "final_norm")(x)
     if return_features:
         return x, aux_total
+    if head is not None:
+        return head(x, embed), aux_total
     if cfg.tie_embeddings:
         logits = embed.attend(x.astype(jnp.float32))
     else:

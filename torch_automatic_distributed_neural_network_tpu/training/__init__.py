@@ -8,6 +8,7 @@ CheckpointManager`` still works and only then imports orbax.
 
 from .losses import (
     blockwise_next_token_loss,
+    masked_lm_loss,
     moe_next_token_loss,
     mse_loss,
     next_token_loss,
@@ -41,6 +42,7 @@ __all__ = [
     "next_token_loss",
     "next_token_loss_mutable",
     "blockwise_next_token_loss",
+    "masked_lm_loss",
     "moe_next_token_loss",
     "seq2seq_loss",
     "mse_loss",

@@ -109,6 +109,30 @@ def seq2seq_loss(params, batch, rng, apply_fn):
     return loss, {"tokens": mask.sum()}
 
 
+def masked_lm_loss(params, batch, rng, apply_fn):
+    """BERT-style masked-LM: cross-entropy only at masked positions.
+
+    Batch: ``input_ids`` [B, S] (with mask tokens substituted in),
+    ``labels`` [B, S] (original token at masked positions, -100
+    elsewhere — the HF ignore-index convention), optional
+    ``segment_ids`` and ``attn_mask`` ([B, S] keep-mask over padding).
+    """
+    tokens, labels = batch["input_ids"], batch["labels"]
+    logits = apply_fn(
+        params, tokens,
+        segment_ids=batch.get("segment_ids"),
+        attn_mask=batch.get("attn_mask"),
+        rngs={"dropout": rng} if rng is not None else None,
+    )
+    keep = labels >= 0
+    losses = optax.softmax_cross_entropy_with_integer_labels(
+        logits, jnp.where(keep, labels, 0)
+    )
+    denom = jnp.maximum(keep.sum(), 1)
+    loss = (losses * keep).sum() / denom
+    return loss, {"tokens": denom.astype(jnp.float32)}
+
+
 def mse_loss(params, batch, rng, apply_fn):
     x = batch.get("x")
     y = batch.get("y", batch.get("label"))
