@@ -211,3 +211,38 @@ def test_import_hf_bert_head_count_policy():
         import_hf_bert(hf.state_dict())
     model2, _ = import_hf_bert(hf.state_dict(), n_heads=4)
     assert model2.cfg.n_heads == 4
+
+
+def test_export_hf_bert_roundtrip():
+    # the door swings both ways: train here, serve from any torch stack —
+    # export -> load into a FRESH transformers model -> logits match
+    transformers = pytest.importorskip("transformers")
+    import torch
+
+    from torch_automatic_distributed_neural_network_tpu.models import (
+        export_hf_bert,
+    )
+
+    model = tiny(type_vocab_size=2)
+    toks = jnp.asarray(
+        np.random.RandomState(3).randint(0, VOCAB, (2, 16)), jnp.int32)
+    variables = model.init(jax.random.key(1), toks)
+    sd = {k: torch.tensor(v) for k, v in export_hf_bert(
+        model, variables).items()}
+    cfg = model.cfg
+    hf = transformers.BertForMaskedLM(transformers.BertConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.d_model,
+        num_hidden_layers=cfg.n_layers,
+        num_attention_heads=cfg.n_heads, intermediate_size=cfg.ff_dim,
+        max_position_embeddings=cfg.max_seq_len, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        hidden_act="gelu",
+    )).eval()
+    missing, unexpected = hf.load_state_dict(sd, strict=False)
+    # only the NSP pooler (which we do not model) may be missing
+    assert all("pooler" in k for k in missing), missing
+    assert not unexpected, unexpected
+    ours = np.asarray(model.apply(variables, toks))
+    with torch.no_grad():
+        theirs = hf(torch.tensor(np.asarray(toks))).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=5e-4, atol=5e-4)

@@ -3,6 +3,7 @@
 from .bert import Bert, BertClassifier, BertEncoder, bert_config
 from .gpt2 import GPT2, gpt2_config
 from .import_hf import (
+    export_hf_bert,
     export_hf_gpt2,
     import_hf_bert,
     export_hf_llama,
@@ -26,6 +27,7 @@ __all__ = [
     "BertEncoder",
     "bert_config",
     "import_hf_bert",
+    "export_hf_bert",
     "GPT2",
     "gpt2_config",
     "import_hf_gpt2",

@@ -46,6 +46,7 @@ def bert_config(size: str = "base", **overrides) -> TransformerConfig:
         n_heads=h,
         max_seq_len=512,
         norm="layernorm",
+        norm_eps=1e-12,  # HF BertConfig.layer_norm_eps default
         act="gelu_exact",
         pos="learned",
         causal=False,

@@ -321,13 +321,36 @@ def import_hf_llama(
     return DecoderLM(cfg), c.assemble(layers)
 
 
+def _stacked_layers(p):
+    """One host transfer for the whole nn.scan-stacked [L, ...] param
+    tree (not per layer), plus a per-layer leaf accessor — the shared
+    skeleton of every exporter."""
+    import jax
+
+    L = jax.tree.map(_np, p["layers"])
+
+    def leaf_at(i):
+        def leaf(*path):
+            node = L
+            for k in path:
+                node = node[k]
+            return node[i]
+
+        return leaf
+
+    return leaf_at
+
+
+def _torch_lin(kernel, in_dim) -> np.ndarray:
+    """our kernel [in, *out] -> torch Linear weight [out, in]."""
+    return np.ascontiguousarray(kernel.reshape(in_dim, -1).T)
+
+
 def export_hf_gpt2(model, variables) -> dict:
     """Our GPT2 -> an HF ``GPT2LMHeadModel`` state_dict (numpy values;
     ``torch.tensor`` them or pass through ``model.load_state_dict`` after
     conversion).  Inverse of :func:`import_hf_gpt2`; the round-trip is
     pinned by tests/test_import_hf.py."""
-    import jax
-
     cfg = model.cfg
     p = variables["params"] if "params" in variables else variables
     d = cfg.d_model
@@ -343,15 +366,9 @@ def export_hf_gpt2(model, variables) -> dict:
         sd["lm_head.weight"] = np.ascontiguousarray(
             _np(p["lm_head"]["kernel"]).T
         )
-    # one host transfer for the whole stacked [L, ...] tree, not per layer
-    L = jax.tree.map(_np, p["layers"])
+    leaf_at = _stacked_layers(p)
     for i in range(cfg.n_layers):
-        def leaf(*path):
-            node = L
-            for k in path:
-                node = node[k]
-            return node[i]
-
+        leaf = leaf_at(i)
         pre = f"transformer.h.{i}."
         qkv_w = np.concatenate(
             [leaf("attn", f"{n}_proj", "kernel").reshape(d, d)
@@ -384,8 +401,6 @@ def _export_llama_family(cfg, p, mlp_block) -> dict:
     """Shared Llama-family export skeleton (inverse of _LlamaCommon):
     embed/final-norm/lm-head header + per-layer attention/norm mapping;
     ``mlp_block(leaf, t, pre, sd)`` fills in the family's MLP keys."""
-    import jax
-
     d = cfg.d_model
     sd: dict[str, np.ndarray] = {
         "model.embed_tokens.weight": _np(p["embed"]["embedding"]),
@@ -397,22 +412,13 @@ def _export_llama_family(cfg, p, mlp_block) -> dict:
         sd["lm_head.weight"] = np.ascontiguousarray(
             _np(p["lm_head"]["kernel"]).T
         )
-    # one host transfer for the whole stacked [L, ...] tree, not per layer
-    L = jax.tree.map(_np, p["layers"])
+    leaf_at = _stacked_layers(p)
     for i in range(cfg.n_layers):
-        def leaf(*path):
-            node = L
-            for k in path:
-                node = node[k]
-            return node[i]
-
+        leaf = leaf_at(i)
         pre = f"model.layers.{i}."
 
         def t(kernel, in_dim=d):
-            # our [in, *out] -> torch Linear [out, in]
-            return np.ascontiguousarray(
-                kernel.reshape(in_dim, -1).T
-            )
+            return _torch_lin(kernel, in_dim)
 
         sd.update({
             pre + "input_layernorm.weight": leaf("attn_norm", "scale"),
@@ -588,8 +594,8 @@ def import_hf_bert(
            in sd) or (
            f"encoder.layer.{n_layers}.attention.self.query.weight" in sd):
         n_layers += 1
+    hf_cfg = getattr(model_or_state_dict, "config", None)
     if n_heads is None:
-        hf_cfg = getattr(model_or_state_dict, "config", None)
         if hf_cfg is not None and getattr(
                 hf_cfg, "num_attention_heads", None):
             n_heads = int(hf_cfg.num_attention_heads)
@@ -612,6 +618,9 @@ def import_hf_bert(
         d_ff=d_ff,
         max_seq_len=max_seq_len or wpe.shape[0],
         type_vocab_size=tte.shape[0],
+        # variants ship non-default eps; a silent mismatch drifts logits
+        norm_eps=float(getattr(hf_cfg, "layer_norm_eps", 1e-12)
+                       if hf_cfg is not None else 1e-12),
         **({"dtype": dtype} if dtype is not None else {}),
     )
     layers = []
@@ -687,3 +696,72 @@ def import_hf_bert(
                               "bias": np.zeros((d,), np.float32)}
         params["mlm_bias"] = np.zeros((vocab,), np.float32)
     return BertEncoder(cfg), {"params": params}
+
+
+def export_hf_bert(model, variables) -> dict:
+    """Our BertEncoder -> an HF ``BertForMaskedLM`` state_dict (numpy
+    values).  Inverse of :func:`import_hf_bert`; the round-trip —
+    export, load into a fresh ``transformers`` model, compare logits —
+    is pinned by tests/test_bert.py."""
+    cfg = model.cfg
+    p = variables["params"] if "params" in variables else variables
+    d = cfg.d_model
+    wte = _np(p["embed"]["embedding"])
+    sd: dict[str, np.ndarray] = {
+        "bert.embeddings.word_embeddings.weight": wte,
+        "bert.embeddings.position_embeddings.weight": _np(p["pos_embed"]),
+        "bert.embeddings.token_type_embeddings.weight": _np(
+            p["seg_embed"]["embedding"]),
+        "bert.embeddings.LayerNorm.weight": _np(p["embed_norm"]["scale"]),
+        "bert.embeddings.LayerNorm.bias": _np(p["embed_norm"]["bias"]),
+        "cls.predictions.transform.dense.weight": np.ascontiguousarray(
+            _np(p["mlm_dense"]["kernel"]).T),
+        "cls.predictions.transform.dense.bias": _np(p["mlm_dense"]["bias"]),
+        "cls.predictions.transform.LayerNorm.weight": _np(
+            p["mlm_norm"]["scale"]),
+        "cls.predictions.transform.LayerNorm.bias": _np(
+            p["mlm_norm"]["bias"]),
+        "cls.predictions.bias": _np(p["mlm_bias"]),
+        "cls.predictions.decoder.weight": wte,  # tied
+        "cls.predictions.decoder.bias": _np(p["mlm_bias"]),
+    }
+    leaf_at = _stacked_layers(p)
+    for i in range(cfg.n_layers):
+        leaf = leaf_at(i)
+        pre = f"bert.encoder.layer.{i}."
+
+        def t(kernel, in_dim=d):
+            return _torch_lin(kernel, in_dim)
+
+        sd.update({
+            pre + "attention.self.query.weight": t(
+                leaf("attn", "q_proj", "kernel")),
+            pre + "attention.self.query.bias": leaf(
+                "attn", "q_proj", "bias").reshape(-1),
+            pre + "attention.self.key.weight": t(
+                leaf("attn", "k_proj", "kernel")),
+            pre + "attention.self.key.bias": leaf(
+                "attn", "k_proj", "bias").reshape(-1),
+            pre + "attention.self.value.weight": t(
+                leaf("attn", "v_proj", "kernel")),
+            pre + "attention.self.value.bias": leaf(
+                "attn", "v_proj", "bias").reshape(-1),
+            # ours [H, hd, d] -> [H*hd(in), d(out)] -> torch [out, in]
+            pre + "attention.output.dense.weight": np.ascontiguousarray(
+                leaf("attn", "o_proj", "kernel").reshape(-1, d).T),
+            pre + "attention.output.dense.bias": leaf(
+                "attn", "o_proj", "bias"),
+            pre + "attention.output.LayerNorm.weight": leaf(
+                "attn_norm", "scale"),
+            pre + "attention.output.LayerNorm.bias": leaf(
+                "attn_norm", "bias"),
+            pre + "intermediate.dense.weight": t(
+                leaf("mlp", "up_proj", "kernel")),
+            pre + "intermediate.dense.bias": leaf("mlp", "up_proj", "bias"),
+            pre + "output.dense.weight": t(
+                leaf("mlp", "down_proj", "kernel"), cfg.ff_dim),
+            pre + "output.dense.bias": leaf("mlp", "down_proj", "bias"),
+            pre + "output.LayerNorm.weight": leaf("mlp_norm", "scale"),
+            pre + "output.LayerNorm.bias": leaf("mlp_norm", "bias"),
+        })
+    return sd

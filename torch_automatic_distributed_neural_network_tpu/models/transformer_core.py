@@ -39,6 +39,7 @@ class TransformerConfig:
     d_ff: int | None = None  # None -> 4*d_model (gelu) / 8/3*d_model (swiglu)
     max_seq_len: int = 1024
     norm: Literal["layernorm", "rmsnorm"] = "layernorm"
+    norm_eps: float = 1e-5  # HF BERT uses 1e-12; GPT-2/Llama 1e-5
     # 'gelu_exact' is the erf formulation (HF BERT's hidden_act='gelu');
     # plain 'gelu' is the tanh approximation (GPT-2's gelu_new)
     act: Literal["gelu", "gelu_exact", "swiglu"] = "gelu"
@@ -100,8 +101,8 @@ class TransformerConfig:
 
 def make_norm(cfg: TransformerConfig, name: str | None = None):
     if cfg.norm == "rmsnorm":
-        return nn.RMSNorm(epsilon=1e-5, dtype=cfg.dtype, name=name)
-    return nn.LayerNorm(epsilon=1e-5, dtype=cfg.dtype, name=name)
+        return nn.RMSNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype, name=name)
+    return nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype, name=name)
 
 
 def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
