@@ -1,0 +1,114 @@
+"""ViT family (models/vit.py): patch-unfold correctness, HF logits
+parity, and the 1-vs-8-device parity oracle (SURVEY.md §4 discipline)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import torch_automatic_distributed_neural_network_tpu as tad
+from torch_automatic_distributed_neural_network_tpu.data.synthetic import (
+    SyntheticClassification,
+)
+from torch_automatic_distributed_neural_network_tpu.models import ViT
+from torch_automatic_distributed_neural_network_tpu.training import (
+    softmax_xent_loss,
+)
+
+
+def tiny():
+    return ViT("test", image_size=32, patch_size=8, num_classes=10,
+               dtype=jnp.float32)
+
+
+def test_patch_unfold_order():
+    # the unfold must produce row-major patches with (ph, pw, c) pixel
+    # order — the contract import_hf_vit's conv transpose relies on.
+    # With an identity-ish patch_proj we can read the patches back.
+    m = tiny()
+    img = jnp.asarray(
+        np.arange(2 * 32 * 32 * 3).reshape(2, 32, 32, 3), jnp.float32)
+    p, c = 8, 3
+    x = img.reshape(2, 4, p, 4, p, c).transpose(0, 1, 3, 2, 4, 5)
+    patches = x.reshape(2, 16, p * p * c)
+    # patch (i, j) upper-left pixel equals image[:, i*8, j*8]
+    np.testing.assert_array_equal(
+        np.asarray(patches[:, 5, :3]),  # patch row 1, col 1
+        np.asarray(img[:, 8, 8, :]),
+    )
+    del m
+
+
+def test_cls_token_attends_to_patches():
+    m = tiny()
+    img = jnp.asarray(np.random.RandomState(0).rand(2, 32, 32, 3),
+                      jnp.float32)
+    v = m.init(jax.random.key(0), img)
+    base = m.apply(v, img)
+    # perturbing the last patch must reach the CLS logits (bidirectional)
+    img2 = img.at[:, -8:, -8:].add(1.0)
+    assert float(jnp.abs(m.apply(v, img2) - base).max()) > 0
+
+
+def test_hf_vit_logits_parity():
+    transformers = pytest.importorskip("transformers")
+    import torch
+
+    from torch_automatic_distributed_neural_network_tpu.models import (
+        import_hf_vit,
+    )
+
+    cfg = transformers.ViTConfig(
+        hidden_size=128, num_hidden_layers=3, num_attention_heads=4,
+        intermediate_size=224, image_size=32, patch_size=8,
+        num_channels=3, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0, hidden_act="gelu",
+    )
+    torch.manual_seed(0)
+    hf = transformers.ViTForImageClassification(cfg).eval()
+    model, variables = import_hf_vit(hf, dtype=jnp.float32)
+    assert model.cfg.core.n_layers == 3
+    assert model.cfg.patch_size == 8 and model.cfg.image_size == 32
+    img = np.random.RandomState(1).rand(2, 3, 32, 32).astype(np.float32)
+    with torch.no_grad():
+        ref = hf(torch.tensor(img)).logits.numpy()
+    got = np.asarray(jax.jit(model.apply)(
+        variables, jnp.asarray(img.transpose(0, 2, 3, 1))))  # NCHW->NHWC
+    np.testing.assert_allclose(got, ref, rtol=5e-4, atol=5e-4)
+    # raw state_dict must refuse to guess the head count
+    with pytest.raises(ValueError, match="n_heads"):
+        import_hf_vit(hf.state_dict())
+
+
+def _trajectory(devices, strategy, steps=3, batch_size=8, lr=1e-3):
+    model = tiny()
+    data = SyntheticClassification(
+        image_shape=(32, 32, 3), num_classes=10, batch_size=batch_size)
+    ad = tad.AutoDistribute(
+        model,
+        optimizer=optax.adamw(lr),
+        loss_fn=softmax_xent_loss,
+        strategy=strategy,
+        devices=devices,
+    )
+    state = ad.init(jax.random.key(0), data.batch(0))
+    losses = []
+    for i in range(steps):
+        state, m = ad.step(state, data.batch(i))
+        losses.append(float(m["loss"]))
+    return losses
+
+
+@pytest.mark.parametrize("strategy", ["dp", "fsdp", "tp_fsdp"])
+def test_vit_1_vs_8_device_parity(strategy):
+    ref = _trajectory(jax.devices()[:1], "dp")
+    got = _trajectory(jax.devices(), strategy)
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_vit_learns():
+    # the linear-teacher task is learnable; 40 steps must cut the loss
+    losses = _trajectory(jax.devices(), "dp", steps=40,
+                         batch_size=64, lr=3e-3)
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.8, losses

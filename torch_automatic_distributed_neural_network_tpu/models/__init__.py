@@ -6,6 +6,7 @@ from .import_hf import (
     export_hf_bert,
     export_hf_gpt2,
     import_hf_bert,
+    import_hf_vit,
     export_hf_llama,
     export_hf_mixtral,
     import_hf_gpt2,
@@ -19,6 +20,7 @@ from .resnet import ResNet, ResNet18Thin, ResNet50, ResNetConfig
 from .torch_bridge import TorchBridge, UnsupportedTorchModule, from_torch
 from .transformer_core import DecoderLM, TransformerConfig
 from .transformer_mt import Seq2SeqTransformer, TransformerMT
+from .vit import ViT, ViTConfig, ViTEncoder, vit_config
 
 __all__ = [
     "MLP",
@@ -53,4 +55,9 @@ __all__ = [
     "TransformerConfig",
     "Seq2SeqTransformer",
     "TransformerMT",
+    "ViT",
+    "ViTConfig",
+    "ViTEncoder",
+    "vit_config",
+    "import_hf_vit",
 ]

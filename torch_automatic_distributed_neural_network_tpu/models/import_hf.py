@@ -564,6 +564,64 @@ def import_hf_mixtral(
     return MoELM(cfg), c.assemble(layers)
 
 
+def _hf_heads_or_raise(model_or_state_dict, n_heads):
+    """Explicit n_heads wins; else the attached HF config; a raw
+    state_dict is REFUSED — a wrong head count splits the per-head
+    fused Q/K/V on the wrong boundary and produces silently wrong
+    logits (same policy as import_hf_llama)."""
+    if n_heads is not None:
+        return int(n_heads)
+    hf_cfg = getattr(model_or_state_dict, "config", None)
+    if hf_cfg is not None and getattr(hf_cfg, "num_attention_heads", None):
+        return int(hf_cfg.num_attention_heads)
+    raise ValueError(
+        "cannot infer the head count from a raw state_dict "
+        "(Q/K/V are per-head fused); pass n_heads= explicitly"
+    )
+
+
+def _hf_norm_eps(model_or_state_dict, default=1e-12) -> float:
+    hf_cfg = getattr(model_or_state_dict, "config", None)
+    return float(getattr(hf_cfg, "layer_norm_eps", default)
+                 if hf_cfg is not None else default)
+
+
+def _hf_encoder_block(L, attn, n_heads, hd, d) -> dict:
+    """The q/k/v/o + intermediate/output mapping every HF encoder
+    layout shares; ``attn`` is the self-attention prefix
+    ('attention.self' for BERT, 'attention.attention' for ViT).
+    LayerNorm placement differs per family (post vs pre) and stays in
+    the caller."""
+    return {
+        "attn": {
+            "q_proj": {
+                "kernel": _lin(L(f"{attn}.query.weight"), (n_heads, hd)),
+                "bias": L(f"{attn}.query.bias").reshape(n_heads, hd),
+            },
+            "k_proj": {
+                "kernel": _lin(L(f"{attn}.key.weight"), (n_heads, hd)),
+                "bias": L(f"{attn}.key.bias").reshape(n_heads, hd),
+            },
+            "v_proj": {
+                "kernel": _lin(L(f"{attn}.value.weight"), (n_heads, hd)),
+                "bias": L(f"{attn}.value.bias").reshape(n_heads, hd),
+            },
+            "o_proj": {
+                "kernel": _np(
+                    L("attention.output.dense.weight")
+                ).T.reshape(n_heads, hd, d),
+                "bias": L("attention.output.dense.bias"),
+            },
+        },
+        "mlp": {
+            "up_proj": {"kernel": _lin(L("intermediate.dense.weight")),
+                        "bias": L("intermediate.dense.bias")},
+            "down_proj": {"kernel": _lin(L("output.dense.weight")),
+                          "bias": L("output.dense.bias")},
+        },
+    }
+
+
 def import_hf_bert(
     model_or_state_dict, *, max_seq_len: int | None = None,
     n_heads: int | None = None, dtype: Any = None,
@@ -594,19 +652,7 @@ def import_hf_bert(
            in sd) or (
            f"encoder.layer.{n_layers}.attention.self.query.weight" in sd):
         n_layers += 1
-    hf_cfg = getattr(model_or_state_dict, "config", None)
-    if n_heads is None:
-        if hf_cfg is not None and getattr(
-                hf_cfg, "num_attention_heads", None):
-            n_heads = int(hf_cfg.num_attention_heads)
-        else:
-            # a wrong head count splits Q/K/V on the wrong boundary and
-            # produces silently wrong logits — refuse to guess for raw
-            # state_dicts (same policy as import_hf_llama)
-            raise ValueError(
-                "cannot infer the head count from a raw state_dict "
-                "(Q/K/V are per-head fused); pass n_heads= explicitly"
-            )
+    n_heads = _hf_heads_or_raise(model_or_state_dict, n_heads)
     hd = d // n_heads
     d_ff = g("encoder.layer.0.intermediate.dense.weight").shape[0]
     cfg = bert_config(
@@ -619,8 +665,7 @@ def import_hf_bert(
         max_seq_len=max_seq_len or wpe.shape[0],
         type_vocab_size=tte.shape[0],
         # variants ship non-default eps; a silent mismatch drifts logits
-        norm_eps=float(getattr(hf_cfg, "layer_norm_eps", 1e-12)
-                       if hf_cfg is not None else 1e-12),
+        norm_eps=_hf_norm_eps(model_or_state_dict),
         **({"dtype": dtype} if dtype is not None else {}),
     )
     layers = []
@@ -632,39 +677,8 @@ def import_hf_bert(
             return {"scale": L(f"{name}.weight"), "bias": L(f"{name}.bias")}
 
         layers.append({
-            "attn": {
-                "q_proj": {
-                    "kernel": _lin(L("attention.self.query.weight"),
-                                   (n_heads, hd)),
-                    "bias": L("attention.self.query.bias").reshape(
-                        n_heads, hd),
-                },
-                "k_proj": {
-                    "kernel": _lin(L("attention.self.key.weight"),
-                                   (n_heads, hd)),
-                    "bias": L("attention.self.key.bias").reshape(
-                        n_heads, hd),
-                },
-                "v_proj": {
-                    "kernel": _lin(L("attention.self.value.weight"),
-                                   (n_heads, hd)),
-                    "bias": L("attention.self.value.bias").reshape(
-                        n_heads, hd),
-                },
-                "o_proj": {
-                    "kernel": _np(
-                        L("attention.output.dense.weight")
-                    ).T.reshape(n_heads, hd, d),
-                    "bias": L("attention.output.dense.bias"),
-                },
-            },
+            **_hf_encoder_block(L, "attention.self", n_heads, hd, d),
             "attn_norm": ln("attention.output.LayerNorm"),
-            "mlp": {
-                "up_proj": {"kernel": _lin(L("intermediate.dense.weight")),
-                            "bias": L("intermediate.dense.bias")},
-                "down_proj": {"kernel": _lin(L("output.dense.weight")),
-                              "bias": L("output.dense.bias")},
-            },
             "mlp_norm": ln("output.LayerNorm"),
         })
     params = {
@@ -765,3 +779,93 @@ def export_hf_bert(model, variables) -> dict:
             pre + "output.LayerNorm.bias": leaf("mlp_norm", "bias"),
         })
     return sd
+
+
+def import_hf_vit(
+    model_or_state_dict, *, n_heads: int | None = None, dtype: Any = None,
+):
+    """HF ``ViTForImageClassification`` / ``ViTModel`` -> (our ViTEncoder,
+    variables).
+
+    The HF patch-embedding conv kernel [d, C, p, p] becomes our single
+    patch Dense [p*p*C, d] via the (kh, kw, c, out) transpose — the same
+    matmul XLA lowers the stride-p conv to, in the pixel order
+    ViTEncoder's unfold produces.  Pre-LN maps directly
+    (layernorm_before/after -> attn_norm/mlp_norm, vit.layernorm ->
+    final_norm).  Logits parity vs ``transformers`` is pinned in
+    tests/test_vit.py.
+    """
+    from .vit import ViTEncoder, vit_config
+
+    sd = _state_dict(model_or_state_dict)
+
+    def g(name):
+        return _get(sd, f"vit.{name}", name)
+
+    conv = g("embeddings.patch_embeddings.projection.weight")
+    d, ch, p, _ = conv.shape
+    pos = g("embeddings.position_embeddings").reshape(-1, d)
+    n_patches = pos.shape[0] - 1
+    image_size = int(round(n_patches ** 0.5)) * p
+    n_layers = 0
+    while (f"vit.encoder.layer.{n_layers}.attention.attention.query.weight"
+           in sd) or (
+           f"encoder.layer.{n_layers}.attention.attention.query.weight"
+           in sd):
+        n_layers += 1
+    n_heads = _hf_heads_or_raise(model_or_state_dict, n_heads)
+    hd = d // n_heads
+    d_ff = g("encoder.layer.0.intermediate.dense.weight").shape[0]
+    has_classifier = "classifier.weight" in sd
+    num_classes = (sd["classifier.weight"].shape[0]
+                   if has_classifier else 0) or 1
+    cfg = vit_config(
+        "base",
+        image_size=image_size,
+        patch_size=p,
+        num_classes=num_classes,
+        d_model=d,
+        n_layers=n_layers,
+        n_heads=n_heads,
+        d_ff=d_ff,
+        norm_eps=_hf_norm_eps(model_or_state_dict),
+        **({"dtype": dtype} if dtype is not None else {}),
+    )
+    layers = []
+    for i in range(n_layers):
+        def L(name):
+            return g(f"encoder.layer.{i}.{name}")
+
+        def ln(name):
+            return {"scale": L(f"{name}.weight"), "bias": L(f"{name}.bias")}
+
+        layers.append({
+            **_hf_encoder_block(L, "attention.attention", n_heads, hd, d),
+            "attn_norm": ln("layernorm_before"),
+            "mlp_norm": ln("layernorm_after"),
+        })
+    params = {
+        # [d, C, p, p] -> [p, p, C, d] -> [p*p*C, d]: ViTEncoder's
+        # (ph, pw, c) unfold order
+        "patch_proj": {
+            "kernel": np.ascontiguousarray(
+                conv.transpose(2, 3, 1, 0)).reshape(p * p * ch, d),
+            "bias": g("embeddings.patch_embeddings.projection.bias"),
+        },
+        "cls_token": g("embeddings.cls_token").reshape(1, 1, d),
+        "pos_embed": pos,
+        "layers": _stack(layers),
+        "final_norm": {"scale": g("layernorm.weight"),
+                       "bias": g("layernorm.bias")},
+    }
+    if has_classifier:
+        params["classifier"] = {
+            "kernel": _lin(sd["classifier.weight"]),
+            "bias": _np(sd["classifier.bias"]),
+        }
+    else:
+        params["classifier"] = {
+            "kernel": np.zeros((d, num_classes), np.float32),
+            "bias": np.zeros((num_classes,), np.float32),
+        }
+    return ViTEncoder(cfg), {"params": params}

@@ -236,6 +236,7 @@ def apply_decoder_backbone(
     return_features: bool = False,
     segment_ids=None,
     head=None,
+    inputs_embeds=None,
 ):
     """Shared decoder body: embed -> (remat'd, scanned) layer stack -> norm
     -> tied/untied head.
@@ -257,24 +258,36 @@ def apply_decoder_backbone(
     ``head(features, embed) -> logits`` replacing the default tied /
     untied LM head — encoder families use it for the MLM transform
     (models/bert.py) without duplicating the "embed" module name.
+
+    ``inputs_embeds`` [B, S, d] bypasses the token embedding entirely
+    (and skips creating it, so no phantom [V, d] param) — continuous-
+    input families (ViT patch embeddings, models/vit.py) enter here.
     """
+    if inputs_embeds is not None:
+        if tokens is not None:
+            raise ValueError("pass tokens or inputs_embeds, not both")
+        embed = None
+        x = inputs_embeds.astype(cfg.dtype)
+        lead = x.shape[:2]
+    else:
+        embed = nn.Embed(
+            cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+            embedding_init=nn.initializers.normal(0.02), name="embed",
+        )
+        x = embed(tokens)
+        lead = tokens.shape
     if positions is None:
-        positions = jnp.arange(tokens.shape[1])[None, :]
-        positions = jnp.broadcast_to(positions, tokens.shape)
-    embed = nn.Embed(
-        cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
-        embedding_init=nn.initializers.normal(0.02), name="embed",
-    )
-    x = embed(tokens)
+        positions = jnp.arange(lead[1])[None, :]
+        positions = jnp.broadcast_to(positions, lead)
     if cfg.pos == "learned":
         pos_emb = self.param(
             "pos_embed", nn.initializers.normal(0.02),
             (cfg.max_seq_len, cfg.d_model), jnp.float32,
         )
-        x = x + pos_emb[None, : tokens.shape[1]].astype(cfg.dtype)
+        x = x + pos_emb[None, : lead[1]].astype(cfg.dtype)
     if cfg.type_vocab_size:
         if segment_ids is None:
-            segment_ids = jnp.zeros_like(tokens)
+            segment_ids = jnp.zeros(lead, jnp.int32)
         x = x + nn.Embed(
             cfg.type_vocab_size, cfg.d_model, dtype=cfg.dtype,
             embedding_init=nn.initializers.normal(0.02), name="seg_embed",
@@ -326,6 +339,11 @@ def apply_decoder_backbone(
         return x, aux_total
     if head is not None:
         return head(x, embed), aux_total
+    if embed is None:
+        raise ValueError(
+            "inputs_embeds has no token embedding to tie an LM head to; "
+            "use return_features=True or pass head="
+        )
     if cfg.tie_embeddings:
         logits = embed.attend(x.astype(jnp.float32))
     else:

@@ -1,0 +1,126 @@
+"""Vision Transformer family on the shared encoder core (extends the
+reference's CNN+transformer example set, SURVEY.md C11/C12, with the
+image-transformer bridge).
+
+TPU-first shape choices: the patch embedding is an unfold + one Dense —
+a single [B*N, p*p*C] x [p*p*C, d] matmul straight onto the MXU (XLA
+lowers a stride-p conv to the same thing; the explicit form keeps the
+HLO obvious) — and everything downstream is the scanned/remat'd
+bidirectional core (``causal=False``, pre-norm like HF ViT), so
+dp/fsdp/tp/tp_fsdp shard plans apply unchanged.
+
+HF layout parity (``transformers`` ViTForImageClassification — CLS
+token, learned positions over [CLS]+patches, pre-LN with final
+LayerNorm, exact-erf GELU) is pinned in tests/test_vit.py via
+``import_hf_vit``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .transformer_core import (
+    DecoderLayer,
+    TransformerConfig,
+    apply_decoder_backbone,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    core: TransformerConfig
+    image_size: int = 224
+    patch_size: int = 16
+    channels: int = 3
+    num_classes: int = 1000
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    def num_params(self) -> int:
+        c = self.core
+        d = c.d_model
+        patch = self.patch_size ** 2 * self.channels * d + d
+        cls_pos = d + (self.num_patches + 1) * d
+        # core.num_params counts embed/pos/head the token families use;
+        # rebuild from the per-layer blocks instead
+        hd = c.head_dim
+        attn = d * (c.n_heads * hd) + 2 * d * (c.kv_heads * hd) + (
+            c.n_heads * hd) * d
+        mlp = 2 * d * c.ff_dim
+        norms = (2 * d) * c.n_layers + d
+        head = d * self.num_classes + self.num_classes
+        return patch + cls_pos + c.n_layers * (attn + mlp) + norms + head
+
+
+def vit_config(size: str = "base", *, image_size: int = 224,
+               patch_size: int = 16, num_classes: int = 1000,
+               **overrides) -> ViTConfig:
+    presets = {
+        # name: (n_layers, d_model, n_heads)
+        "base": (12, 768, 12),    # ViT-B/16: 86M
+        "large": (24, 1024, 16),  # ViT-L/16: 307M
+        # tiny config for tests / CPU sim
+        "test": (2, 128, 4),
+    }
+    L, d, h = presets[size]
+    base = dict(
+        vocab_size=1,  # unused: inputs are patch embeddings
+        d_model=d,
+        n_layers=L,
+        n_heads=h,
+        norm="layernorm",
+        act="gelu_exact",
+        pos="learned",
+        causal=False,
+        norm_order="pre",
+        final_norm=True,
+        tie_embeddings=False,
+        max_seq_len=(image_size // patch_size) ** 2 + 1,  # +1 CLS
+    )
+    base.update(overrides)
+    return ViTConfig(
+        core=TransformerConfig(**base),
+        image_size=image_size, patch_size=patch_size,
+        num_classes=num_classes,
+    )
+
+
+class ViTEncoder(nn.Module):
+    """images [B, H, W, C] -> classification logits [B, num_classes]
+    (or final hidden states with ``return_features=True``)."""
+
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, images, return_features: bool = False):
+        cfg, core = self.cfg, self.cfg.core
+        p, d = cfg.patch_size, core.d_model
+        b, hh, ww, c = images.shape
+        nh, nw = hh // p, ww // p
+        # unfold to [B, N, p*p*C] (row-major patches, pixel order
+        # (ph, pw, c) — matches the HF conv-kernel transpose in
+        # import_hf_vit) and project with one Dense
+        x = images.astype(core.dtype).reshape(b, nh, p, nw, p, c)
+        x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, nh * nw, p * p * c)
+        x = nn.Dense(d, dtype=core.dtype, name="patch_proj")(x)
+        cls = self.param("cls_token", nn.initializers.normal(0.02),
+                         (1, 1, d), jnp.float32)
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls.astype(core.dtype), (b, 1, d)), x], axis=1)
+        feats, _ = apply_decoder_backbone(
+            self, core, None, None, None, DecoderLayer,
+            return_features=True, inputs_embeds=x,
+        )
+        if return_features:
+            return feats
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32,
+                        name="classifier")(feats[:, 0].astype(jnp.float32))
+
+
+def ViT(size: str = "base", **kw) -> ViTEncoder:
+    return ViTEncoder(vit_config(size, **kw))
