@@ -23,21 +23,27 @@ def tiny():
 
 
 def test_patch_unfold_order():
-    # the unfold must produce row-major patches with (ph, pw, c) pixel
-    # order — the contract import_hf_vit's conv transpose relies on.
-    # With an identity-ish patch_proj we can read the patches back.
-    m = tiny()
+    # pins the MODEL's unfold (the exact function ViTEncoder calls):
+    # row-major patches, (ph, pw, c) pixel order — the contract
+    # import_hf_vit's conv transpose relies on
+    from torch_automatic_distributed_neural_network_tpu.models.vit import (
+        unfold_patches,
+    )
+
+    p, c = 8, 3
     img = jnp.asarray(
         np.arange(2 * 32 * 32 * 3).reshape(2, 32, 32, 3), jnp.float32)
-    p, c = 8, 3
-    x = img.reshape(2, 4, p, 4, p, c).transpose(0, 1, 3, 2, 4, 5)
-    patches = x.reshape(2, 16, p * p * c)
-    # patch (i, j) upper-left pixel equals image[:, i*8, j*8]
+    patches = unfold_patches(img, p)
+    assert patches.shape == (2, 16, p * p * c)
+    # patch index 5 = row 1, col 1 (row-major over the 4x4 patch grid);
+    # its first c values are the image pixel at (8, 8)
     np.testing.assert_array_equal(
-        np.asarray(patches[:, 5, :3]),  # patch row 1, col 1
-        np.asarray(img[:, 8, 8, :]),
-    )
-    del m
+        np.asarray(patches[:, 5, :c]), np.asarray(img[:, 8, 8, :]))
+    # pixel (ph, pw) within a patch lands at offset (ph*p + pw)*c
+    ph, pw = 3, 5
+    np.testing.assert_array_equal(
+        np.asarray(patches[:, 0, (ph * p + pw) * c:(ph * p + pw + 1) * c]),
+        np.asarray(img[:, ph, pw, :]))
 
 
 def test_cls_token_attends_to_patches():

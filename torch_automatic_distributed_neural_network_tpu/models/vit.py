@@ -44,17 +44,14 @@ class ViTConfig:
     def num_params(self) -> int:
         c = self.core
         d = c.d_model
-        patch = self.patch_size ** 2 * self.channels * d + d
-        cls_pos = d + (self.num_patches + 1) * d
-        # core.num_params counts embed/pos/head the token families use;
-        # rebuild from the per-layer blocks instead
-        hd = c.head_dim
-        attn = d * (c.n_heads * hd) + 2 * d * (c.kv_heads * hd) + (
-            c.n_heads * hd) * d
-        mlp = 2 * d * c.ff_dim
-        norms = (2 * d) * c.n_layers + d
-        head = d * self.num_classes + self.num_classes
-        return patch + cls_pos + c.n_layers * (attn + mlp) + norms + head
+        # the core's analytic count (same bias-free convention as every
+        # family — ONE formula, not a drifting copy) with its token
+        # embedding (vocab_size=1 -> d) swapped for patch/CLS/classifier;
+        # the core's learned-pos term already covers [CLS]+patches
+        return (c.num_params() - c.vocab_size * d
+                + self.patch_size ** 2 * self.channels * d  # patch_proj
+                + d                                         # cls_token
+                + d * self.num_classes)                     # classifier
 
 
 def vit_config(size: str = "base", *, image_size: int = 224,
@@ -90,6 +87,18 @@ def vit_config(size: str = "base", *, image_size: int = 224,
     )
 
 
+def unfold_patches(images, patch_size: int):
+    """[B, H, W, C] -> [B, N, p*p*C]: row-major patches, pixel order
+    (ph, pw, c) inside each patch — THE pixel-order contract the HF
+    conv-kernel transpose in import_hf_vit relies on (pinned directly
+    in tests/test_vit.py)."""
+    p = patch_size
+    b, hh, ww, c = images.shape
+    nh, nw = hh // p, ww // p
+    x = images.reshape(b, nh, p, nw, p, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(b, nh * nw, p * p * c)
+
+
 class ViTEncoder(nn.Module):
     """images [B, H, W, C] -> classification logits [B, num_classes]
     (or final hidden states with ``return_features=True``)."""
@@ -100,13 +109,9 @@ class ViTEncoder(nn.Module):
     def __call__(self, images, return_features: bool = False):
         cfg, core = self.cfg, self.cfg.core
         p, d = cfg.patch_size, core.d_model
-        b, hh, ww, c = images.shape
-        nh, nw = hh // p, ww // p
-        # unfold to [B, N, p*p*C] (row-major patches, pixel order
-        # (ph, pw, c) — matches the HF conv-kernel transpose in
-        # import_hf_vit) and project with one Dense
-        x = images.astype(core.dtype).reshape(b, nh, p, nw, p, c)
-        x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, nh * nw, p * p * c)
+        b = images.shape[0]
+        # unfold + one Dense: the patch embedding as a single MXU matmul
+        x = unfold_patches(images.astype(core.dtype), p)
         x = nn.Dense(d, dtype=core.dtype, name="patch_proj")(x)
         cls = self.param("cls_token", nn.initializers.normal(0.02),
                          (1, 1, d), jnp.float32)
