@@ -39,6 +39,23 @@ def test_fit_reports_candidates(capsys):
     assert summary["mesh"]["data"] == 8
 
 
+def test_fit_vit_and_bert_families(capsys):
+    """The encoder families answer `tadnn fit` too: vit interprets
+    --seq as the image side (224 default swapped in for the LM 1024),
+    bert rejects the causal blockwise loss."""
+    assert cli.main(["fit", "--family", "vit", "--size", "test",
+                     "--seq", "32", "--batch", "8",
+                     "--strategy", "dp", "--precision", "fp32"]) == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert json.loads(lines[0])["fits"] is True
+    for fam in ("bert", "vit"):
+        assert cli.main(["fit", "--family", fam, "--size", "test",
+                         "--seq", "32", "--batch", "8",
+                         "--loss", "blockwise"]) == 1
+        err = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert "causal" in err["error"]
+
+
 def test_run_executes_script(tmp_path, capsys):
     script = tmp_path / "hello.py"
     script.write_text(

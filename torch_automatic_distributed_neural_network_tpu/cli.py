@@ -114,33 +114,50 @@ def cmd_fit(args: argparse.Namespace) -> int:
     import optax
 
     from . import AutoDistribute
-    from .models import GPT2, Bert, Llama, MoE
+    from .models import GPT2, Bert, Llama, MoE, ViT
     from .training import (
         blockwise_next_token_loss,
         masked_lm_loss,
         moe_next_token_loss,
         next_token_loss,
+        softmax_xent_loss,
     )
 
     family = {"gpt2": GPT2, "llama": Llama, "moe": MoE,
-              "bert": Bert}[args.family]
+              "bert": Bert, "vit": ViT}[args.family]
     size = args.size or {"gpt2": "1p3b", "llama": "8b", "moe": "test",
-                         "bert": "large"}[args.family]
-    model = family(size, max_seq_len=args.seq)
-    if args.family == "bert":
-        if args.loss == "blockwise":
-            # blockwise CE is a CAUSAL next-token loss; silently running
-            # it on the bidirectional encoder would fit-report a graph no
-            # real BERT config trains (round-5 review)
-            print(json.dumps({"error": "--loss blockwise is next-token "
-                              "(causal); bert uses masked LM"}))
-            return 1
-        loss = masked_lm_loss
-    elif args.loss == "blockwise":
-        loss = blockwise_next_token_loss()
+                         "bert": "large", "vit": "large"}[args.family]
+    if args.loss == "blockwise" and args.family in ("bert", "vit"):
+        # blockwise CE is a CAUSAL next-token loss; silently running it
+        # on an encoder would fit-report a graph no real config trains
+        print(json.dumps({"error": "--loss blockwise is next-token "
+                          "(causal); bert uses masked LM, vit uses "
+                          "classification"}))
+        return 1
+    if args.family == "vit":
+        side = args.seq or 224  # --seq is the image side for ViT
+        model = family(size, image_size=side)
+        loss = softmax_xent_loss
+        sample = {"x": np.zeros((args.batch, side, side, 3), np.float32),
+                  "label": np.zeros((args.batch,), np.int32)}
     else:
-        loss = (moe_next_token_loss if args.family == "moe"
-                else next_token_loss)
+        seq = args.seq or 1024
+        model = family(size, max_seq_len=seq)
+        if args.family == "bert":
+            loss = masked_lm_loss
+            sample = {
+                "input_ids": np.zeros((args.batch, seq), np.int32),
+                "labels": np.full((args.batch, seq), -100, np.int32),
+            }
+        else:
+            if args.loss == "blockwise":
+                loss = blockwise_next_token_loss()
+            else:
+                loss = (moe_next_token_loss if args.family == "moe"
+                        else next_token_loss)
+            sample = {
+                "tokens": np.zeros((args.batch, seq + 1), np.int32),
+            }
     ad = AutoDistribute(
         model,
         optimizer=optax.adamw(1e-4),
@@ -148,11 +165,6 @@ def cmd_fit(args: argparse.Namespace) -> int:
         strategy=args.strategy,
         precision=args.precision,
     )
-    if args.family == "bert":
-        sample = {"input_ids": np.zeros((args.batch, args.seq), np.int32),
-                  "labels": np.full((args.batch, args.seq), -100, np.int32)}
-    else:
-        sample = {"tokens": np.zeros((args.batch, args.seq + 1), np.int32)}
     if args.strategy == "search":
         ad.build_plan(jax.random.key(0), sample)
         entries = ad.search_report or [
@@ -243,11 +255,14 @@ def main(argv: list[str] | None = None) -> int:
              "escalation ladder and reports every candidate",
     )
     p.add_argument("--family", default="gpt2",
-                   choices=("gpt2", "llama", "moe", "bert"))
+                   choices=("gpt2", "llama", "moe", "bert", "vit"))
     p.add_argument("--size", default=None,
                    help="model size preset; default per family "
-                        "(gpt2: 1p3b, llama: 8b, moe: test, bert: large)")
-    p.add_argument("--seq", type=int, default=1024)
+                        "(gpt2: 1p3b, llama: 8b, moe: test, bert: large, "
+                        "vit: large); for vit, --seq is the image side")
+    p.add_argument("--seq", type=int, default=None,
+                   help="sequence length (default 1024); for vit, the "
+                        "image side (default 224)")
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--strategy", default="search")
     p.add_argument("--precision", default="mixed")

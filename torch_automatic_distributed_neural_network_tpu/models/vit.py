@@ -46,9 +46,11 @@ class ViTConfig:
         d = c.d_model
         # the core's analytic count (same bias-free convention as every
         # family — ONE formula, not a drifting copy) with its token
-        # embedding (vocab_size=1 -> d) swapped for patch/CLS/classifier;
-        # the core's learned-pos term already covers [CLS]+patches
-        return (c.num_params() - c.vocab_size * d
+        # embedding AND untied lm_head (neither instantiated here)
+        # swapped for patch/CLS/classifier; the core's learned-pos term
+        # already covers [CLS]+patches
+        emb = c.vocab_size * d * (1 if c.tie_embeddings else 2)
+        return (c.num_params() - emb
                 + self.patch_size ** 2 * self.channels * d  # patch_proj
                 + d                                         # cls_token
                 + d * self.num_classes)                     # classifier
