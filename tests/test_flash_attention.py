@@ -88,3 +88,86 @@ def test_flash_under_sharded_mesh():
             lambda q, k, v: attention(q, k, v, causal=True, impl="flash")
         )(q, k, v)
     assert jnp.max(jnp.abs(ref - out)) < 2e-5
+
+
+@pytest.mark.parametrize("s,w,bq,bk", [
+    (96, 17, 32, 32),     # window not aligned to blocks
+    (128, 64, 32, 64),    # block-aligned window
+    (64, 1, 16, 16),      # degenerate: attend self only
+    (80, 200, 32, 32),    # window > seq == full causal
+])
+def test_sliding_window_matches_oracle(s, w, bq, bk):
+    q, k, v = _qkv(2, s, 4, 32, seed=s + w)
+
+    def loss_ref(q_, k_, v_):
+        return jnp.sum(
+            xla_attention(q_, k_, v_, causal=True, window=w) ** 2)
+
+    def loss_fl(q_, k_, v_):
+        return jnp.sum(flash_attention(
+            q_, k_, v_, causal=True, window=w, block_q=bq, block_k=bk,
+        ) ** 2)
+
+    ref = xla_attention(q, k, v, causal=True, window=w)
+    out = flash_attention(q, k, v, causal=True, window=w,
+                          block_q=bq, block_k=bk)
+    assert jnp.max(jnp.abs(ref - out)) < 2e-5
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_fl = jax.grad(loss_fl, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_fl):
+        assert jnp.max(jnp.abs(a - b)) < 2e-4
+
+
+def test_sliding_window_gqa_and_chunked():
+    from torch_automatic_distributed_neural_network_tpu.ops.attention import (
+        chunked_attention,
+    )
+
+    q, k, v = _qkv(2, 128, 8, 32, hk=2, seed=7)
+    ref = xla_attention(q, k, v, causal=True, window=21)
+    out = flash_attention(q, k, v, causal=True, window=21,
+                          block_q=32, block_k=32)
+    assert jnp.max(jnp.abs(ref - out)) < 2e-5
+    chk = chunked_attention(q, k, v, causal=True, window=21, block_q=32)
+    assert jnp.max(jnp.abs(ref - chk)) < 2e-5
+
+
+def test_sliding_window_validation():
+    q, k, v = _qkv(1, 32, 2, 16)
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, k, v, causal=False, window=8)
+    with pytest.raises(ValueError, match="causal"):
+        xla_attention(q, k, v, causal=False, window=8)
+    with pytest.raises(ValueError, match="window"):
+        flash_attention(q, k, v, causal=True, window=0)
+    with pytest.raises(NotImplementedError, match="context parallelism"):
+        from torch_automatic_distributed_neural_network_tpu.ops.attention import (  # noqa: E501
+            attention as attn_dispatch,
+        )
+        attn_dispatch(q, k, v, causal=True, window=8, impl="ring")
+
+
+def test_window_validation_shared_across_paths():
+    # round-5 review: window<1 must be rejected by EVERY path — with the
+    # finite mask bias an all-masked row softmaxes UNIFORMLY over all
+    # keys (acausal leak), so xla/chunked must error like flash does
+    from torch_automatic_distributed_neural_network_tpu.ops.attention import (
+        attention as attn_dispatch,
+        chunked_attention,
+    )
+
+    q, k, v = _qkv(1, 32, 2, 16)
+    for fn in (xla_attention, chunked_attention):
+        with pytest.raises(ValueError, match=">= 1"):
+            fn(q, k, v, causal=True, window=0)
+    with pytest.raises(ValueError, match=">= 1"):
+        attn_dispatch(q, k, v, causal=True, window=-3)
+    # and a contradictory MODEL config is rejected at construction
+    from torch_automatic_distributed_neural_network_tpu.models.transformer_core import (  # noqa: E501
+        TransformerConfig,
+    )
+
+    with pytest.raises(ValueError, match="causal"):
+        TransformerConfig(causal=False, sliding_window=64)
+    with pytest.raises(ValueError, match=">= 1"):
+        TransformerConfig(sliding_window=0)

@@ -331,3 +331,34 @@ def test_mixtral_raw_state_dict_defaults_rope_theta_1e6():
     np.testing.assert_allclose(
         np.asarray(logits), ref, rtol=5e-4, atol=5e-4
     )
+
+
+def test_mistral_logits_match_transformers():
+    """The Mistral family imports through import_hf_llama (identical
+    state-dict layout): sliding_window and rms_norm_eps thread from the
+    attached config, and seq > window exercises the causal band for
+    real (window=8, seq=17 — a full-attention run differs by ~0.4)."""
+    cfg = transformers.MistralConfig(
+        vocab_size=160, hidden_size=128, intermediate_size=224,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rope_theta=1e6, sliding_window=8,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf = transformers.MistralForCausalLM(cfg).eval()
+    model, variables = import_hf_llama(hf, dtype=jnp.float32)
+    assert model.cfg.sliding_window == 8
+    assert model.cfg.rope_theta == 1e6
+    assert model.cfg.norm_eps == pytest.approx(1e-6)  # Mistral default
+    tokens = np.random.RandomState(1).randint(0, 160, (2, 17))
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens)).logits.numpy()
+    got = _logits_ours(model, variables, tokens)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+    # the window genuinely binds: disabling it must change the logits
+    import dataclasses
+
+    full = type(model)(cfg=dataclasses.replace(
+        model.cfg, sliding_window=None))
+    got_full = np.asarray(full.apply(variables, jnp.asarray(tokens)))
+    assert np.abs(got_full - got).max() > 1e-2

@@ -183,6 +183,16 @@ def forward_cached(
     routed instead of dense FLOPs."""
     if moe_decode not in ("dense", "routed"):
         raise ValueError(f"unknown moe_decode {moe_decode!r}")
+    if cfg.sliding_window is not None and (
+            cache.k.shape[2] > cfg.sliding_window):
+        # the cache keeps every key, so cached attention is FULL causal —
+        # exact only while total length stays inside the window; beyond
+        # it a rolling-buffer cache would be needed
+        raise NotImplementedError(
+            f"KV-cache decode beyond the sliding window is not supported "
+            f"(window={cfg.sliding_window}, cache max_len="
+            f"{cache.k.shape[2]}); cap prompt+new tokens at the window"
+        )
     if "layers" not in params:
         raise ValueError(
             "forward_cached needs the scanned parameter layout (a stacked "

@@ -19,8 +19,11 @@ tests/test_torch_crosscheck.py and tests/test_import_hf.py):
 - our ``rope`` is the rotate-half formulation HF Llama uses — weights
   import with NO channel permutation;
 - ``nn.gelu`` (tanh approximation) == HF ``gelu_new``;
-- LayerNorm/RMSNorm epsilon 1e-5 == GPT-2's ``layer_norm_epsilon`` and
-  Llama-3's ``rms_norm_eps``;
+- norm epsilons THREAD from the checkpoint's config rather than being
+  assumed: ``rms_norm_eps`` for the Llama/Mistral family (Mistral
+  defaults 1e-6 where Llama-3 uses 1e-5 — a mismatch drifts logits
+  5.8e-3), ``layer_norm_eps`` for BERT/ViT (1e-12); GPT-2 keeps the
+  1e-5 both sides use;
 - HF GPT-2 uses Conv1D ([in, out] weights — our kernel orientation,
   no transpose); HF Llama/Mixtral use nn.Linear ([out, in] — transposed
   here);
@@ -234,7 +237,15 @@ class _LlamaCommon:
         return _get(self.sd, f"model.{name}", name)
 
     def cfg_kwargs(self, dtype) -> dict:
+        # Mistral-family configs carry sliding_window (None = full
+        # attention); Llama configs have no such attribute
+        window = getattr(self.hf_cfg, "sliding_window", None)
         return dict(
+            # HF Llama-3 uses 1e-5 but Mistral defaults to 1e-6 — a
+            # mismatched eps drifts every RMSNorm (measured 5.8e-3 on
+            # random-init Mistral logits)
+            norm_eps=float(getattr(self.hf_cfg, "rms_norm_eps", 1e-5)
+                           if self.hf_cfg is not None else 1e-5),
             vocab_size=self.vocab,
             d_model=self.d,
             n_layers=self.n_layers,
@@ -246,6 +257,7 @@ class _LlamaCommon:
             pos="rope",
             tie_embeddings=self.tied,
             rope_theta=self.rope_theta,
+            sliding_window=int(window) if window else None,
             **({"dtype": dtype} if dtype is not None else {}),
         )
 
@@ -300,6 +312,12 @@ def import_hf_llama(
     10000.0.  Raw state_dicts (no attached config) must pass ``n_heads``
     / ``n_kv_heads`` explicitly — head_dim is not recoverable from
     weight shapes.
+
+    The Mistral family imports through this same function (identical
+    state-dict layout); an attached ``MistralConfig``'s
+    ``sliding_window`` is threaded into ``cfg.sliding_window`` so the
+    imported model attends with the same causal band it was trained
+    with.
     """
     c = _LlamaCommon(model_or_state_dict, max_seq_len, rope_theta,
                      n_heads=n_heads, n_kv_heads=n_kv_heads)

@@ -16,6 +16,9 @@ def llama_config(size: str = "8b", **overrides) -> TransformerConfig:
         "8b": (32, 4096, 32, 8, 14336, 128256),
         "3b": (28, 3072, 24, 8, 8192, 128256),
         "1b": (16, 2048, 32, 8, 8192, 128256),
+        # Mistral-7B-v0.1 geometry (sliding_window=4096, theta 1e6
+        # applied below)
+        "mistral-7b": (32, 4096, 32, 8, 14336, 32000),
         # tiny configs for tests / CPU sim
         "test": (2, 128, 4, 2, 384, 1024),
         "nano": (4, 256, 8, 4, 768, 32000),
@@ -35,6 +38,8 @@ def llama_config(size: str = "8b", **overrides) -> TransformerConfig:
         tie_embeddings=False,
         rope_theta=500000.0,
     )
+    if size == "mistral-7b":
+        base.update(rope_theta=1e6, sliding_window=4096)
     base.update(overrides)
     return TransformerConfig(**base)
 

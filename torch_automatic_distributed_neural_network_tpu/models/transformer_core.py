@@ -47,6 +47,12 @@ class TransformerConfig:
     # False -> bidirectional self-attention: the same backbone serves
     # encoder-only families (BERT, models/bert.py)
     causal: bool = True
+    # Mistral-style sliding-window attention: position q attends keys in
+    # (q - window, q].  None = full causal.  Native in the Pallas flash
+    # kernel (out-of-band blocks skipped at the grid level) and the
+    # xla/chunked paths; unsupported under cp (ring/ulysses) and in the
+    # KV-cache decode path beyond the window (both raise).
+    sliding_window: int | None = None
     # 'post' = original-transformer/BERT residual order
     # (norm AFTER the residual add); 'pre' = GPT-2/Llama
     norm_order: Literal["pre", "post"] = "pre"
@@ -65,6 +71,19 @@ class TransformerConfig:
     # difference between fitting and OOMing GPT-2 1.3B on one 16 GB chip.
     remat_policy: Literal["dots", "nothing"] = "dots"
     rope_theta: float = 10000.0
+
+    def __post_init__(self):
+        if self.sliding_window is not None:
+            if not self.causal:
+                raise ValueError(
+                    "sliding_window requires causal=True — a windowed "
+                    "bidirectional encoder would silently run FULL "
+                    "attention (the ops layer only bands causal scores)"
+                )
+            if self.sliding_window < 1:
+                raise ValueError(
+                    f"sliding_window must be >= 1, got {self.sliding_window}"
+                )
 
     @property
     def kv_heads(self) -> int:
@@ -154,8 +173,9 @@ class SelfAttention(nn.Module):
     def __call__(self, x, positions, mask=None):
         q, k, v = self.qkv(x, positions)
         out = attention(
-            q, k, v, causal=self.cfg.causal, mask=mask,
-            impl=self.cfg.attention_impl,
+            q, k, v, causal=self.cfg.causal,
+            window=self.cfg.sliding_window,
+            mask=mask, impl=self.cfg.attention_impl,
         )
         return self.out_proj(out)
 

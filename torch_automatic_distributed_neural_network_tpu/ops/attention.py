@@ -40,6 +40,20 @@ Impl = Literal["xla", "chunked", "flash", "ring", "auto"]
 CHUNKED_MIN_SEQ = 1024
 
 
+def _check_window(window, causal):
+    """Shared by every attention entry point: a window only makes sense
+    as a causal band, and window < 1 would mask EVERY key — with the
+    finite mask bias that yields a UNIFORM softmax over all positions
+    (an acausality leak), not an error, so reject it up front."""
+    if window is None:
+        return
+    if not causal:
+        raise ValueError("window= requires causal=True (the sliding "
+                         "window is a causal band)")
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+
+
 def _mask_bias(scores_dtype, mask):
     big_neg = jnp.finfo(scores_dtype).min * 0.5
     return jnp.where(mask, 0.0, big_neg).astype(scores_dtype)
@@ -51,11 +65,13 @@ def xla_attention(
     v: jax.Array,
     *,
     causal: bool = False,
+    window: int | None = None,
     mask: jax.Array | None = None,
     softmax_dtype=jnp.float32,
 ) -> jax.Array:
     """Reference einsum attention.  q,k,v: [B, S, H, D] (k,v may have fewer
     heads for GQA — broadcast over query groups)."""
+    _check_window(window, causal)
     b, sq, hq, d = q.shape
     _, sk, hk, _ = k.shape
     if hk != hq:
@@ -66,6 +82,10 @@ def xla_attention(
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(softmax_dtype) * scale
     if causal:
         causal_mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        if window is not None:
+            # sliding band: q attends keys in (q - window, q]
+            causal_mask &= jnp.triu(
+                jnp.ones((sq, sk), bool), k=sk - sq - window + 1)
         scores = scores + _mask_bias(scores.dtype, causal_mask[None, None])
     if mask is not None:
         # mask: [B, 1|H, Q|1, K] boolean, True = attend
@@ -80,6 +100,7 @@ def chunked_attention(
     v: jax.Array,
     *,
     causal: bool = False,
+    window: int | None = None,
     mask: jax.Array | None = None,
     block_q: int = 256,
     softmax_dtype=jnp.float32,
@@ -94,6 +115,7 @@ def chunked_attention(
     algorithm's memory shape in pure XLA, so it runs on any backend and
     supports explicit masks (which the Pallas kernel does not).
     """
+    _check_window(window, causal)
     b, sq, hq, d = q.shape
     _, sk, hk, _ = k.shape
     if hk != hq:
@@ -122,6 +144,9 @@ def chunked_attention(
             # global q position p attends key positions <= p + (sk - sq)
             q_pos = start + jnp.arange(block_q)
             allow = k_pos[None, :] <= q_pos[:, None] + (sk - sq)
+            if window is not None:
+                allow &= (k_pos[None, :]
+                          > q_pos[:, None] + (sk - sq) - window)
             scores = scores + _mask_bias(scores.dtype, allow[None, None])
         if mask is not None:
             m = mask
@@ -164,6 +189,7 @@ def attention(
     v: jax.Array,
     *,
     causal: bool = False,
+    window: int | None = None,
     mask: jax.Array | None = None,
     impl: Impl = "auto",
 ) -> jax.Array:
@@ -174,8 +200,14 @@ def attention(
     head count divides the cp degree (cheapest: two all_to_alls), ring
     attention otherwise (SURVEY.md §5 long-context tiers).  Without a
     context (or cp=1): plain XLA attention.
+
+    ``window`` (requires ``causal=True``) is Mistral-style sliding-window
+    attention, supported natively by the xla/chunked/flash paths (the
+    flash kernel skips out-of-band blocks at the grid level).
     """
     from ..parallel import context as pctx
+
+    _check_window(window, causal)
 
     ctx = pctx.current()
     cp = ctx.seq_degree if ctx is not None else 1
@@ -205,9 +237,11 @@ def attention(
             impl = "xla"
 
     if impl == "xla":
-        return xla_attention(q, k, v, causal=causal, mask=mask)
+        return xla_attention(q, k, v, causal=causal, window=window,
+                             mask=mask)
     if impl == "chunked":
-        return chunked_attention(q, k, v, causal=causal, mask=mask)
+        return chunked_attention(q, k, v, causal=causal, window=window,
+                                 mask=mask)
     if impl == "flash":
         from .flash_attention import flash_attention
 
@@ -235,7 +269,7 @@ def attention(
             if tp > 1 and q.shape[2] % tp:
                 # head count indivisible by the tensor degree — the
                 # einsum path under GSPMD is the safe fallback
-                return xla_attention(q, k, v, causal=causal)
+                return xla_attention(q, k, v, causal=causal, window=window)
             if k.shape[2] != q.shape[2]:
                 # GQA: broadcast K/V heads first so all three operands
                 # shard evenly on the head axis (n_kv_heads may not
@@ -245,18 +279,25 @@ def attention(
                 v = jnp.repeat(v, rep, axis=2)
             spec = P(ctx.batch_spec_entry(), None, head_axis, None)
             fn = shard_map(
-                functools.partial(flash_attention, causal=causal),
+                functools.partial(flash_attention, causal=causal,
+                                  window=window),
                 mesh=ctx.mesh,
                 in_specs=(spec, spec, spec),
                 out_specs=spec,
                 check_vma=False,
             )
             return fn(q, k, v)
-        return flash_attention(q, k, v, causal=causal)
+        return flash_attention(q, k, v, causal=causal, window=window)
     if impl in ("ring", "ulysses"):
         if mask is not None:
             raise NotImplementedError(
                 f"{impl} attention does not take explicit masks (causal only)"
+            )
+        if window is not None:
+            raise NotImplementedError(
+                "sliding-window attention is not yet supported under "
+                "context parallelism (ring/ulysses) — train windowed "
+                "models with dp/fsdp/tp, or drop seq_parallel"
             )
         if ctx is None or cp <= 1:
             # degenerate: no seq axis -> plain attention is identical

@@ -48,6 +48,36 @@ class _Cfg:
     block_q: int
     block_k: int
     interpret: bool
+    # sliding window (Mistral-style): attend iff q_pos - window < k_pos
+    # <= q_pos.  None = full causal.  Requires causal=True.
+    window: int | None = None
+
+
+def _block_relevant(qi, ki, cfg: _Cfg):
+    """Grid-level whole-block skip: True iff ANY (q, k) pair in the
+    (qi, ki) tile can attend.  Causal skips above the diagonal; a
+    sliding window additionally skips blocks entirely OLDER than
+    q_block_start - window (window implies causal, enforced at entry)."""
+    if not cfg.causal:
+        return True
+    ok = ki * cfg.block_k <= qi * cfg.block_q + cfg.block_q - 1
+    if cfg.window is not None:
+        ok = jnp.logical_and(
+            ok,
+            ki * cfg.block_k + cfg.block_k - 1 > qi * cfg.block_q - cfg.window,
+        )
+    return ok
+
+
+def _pair_mask(q_pos, k_pos, cfg: _Cfg):
+    """Element mask shared by forward and recompute: key padding,
+    causality, sliding window."""
+    mask = k_pos < cfg.seq_k
+    if cfg.causal:
+        mask = jnp.logical_and(mask, q_pos >= k_pos)
+    if cfg.window is not None:
+        mask = jnp.logical_and(mask, q_pos - k_pos < cfg.window)
+    return mask
 
 
 def _default_interpret() -> bool:
@@ -71,14 +101,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         m_ref[:] = jnp.full_like(m_ref, _NEG_BIG)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # causal: skip blocks entirely above the diagonal
-    diag_ok = (
-        ki * cfg.block_k <= qi * cfg.block_q + cfg.block_q - 1
-        if cfg.causal
-        else True
-    )
-
-    @pl.when(diag_ok)
+    # skip blocks with no attendable pair (causal diagonal / window band)
+    @pl.when(_block_relevant(qi, ki, cfg))
     def _block():
         q = q_ref[0]  # [bq, d]
         k = k_ref[0]  # [bk, d]
@@ -91,10 +115,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
             jnp.int32, s.shape, 0)
         k_pos = ki * cfg.block_k + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1)
-        mask = k_pos < cfg.seq_k
-        if cfg.causal:
-            mask = jnp.logical_and(mask, q_pos >= k_pos)
-        s = jnp.where(mask, s, _NEG_BIG)
+        s = jnp.where(_pair_mask(q_pos, k_pos, cfg), s, _NEG_BIG)
 
         m_prev = m_ref[:, :1]  # [bq, 1] (stored broadcast over lanes)
         l_prev = l_ref[:, :1]
@@ -179,10 +200,7 @@ def _recompute_p(q, k, qi, ki, lse, cfg: _Cfg, scale):
     ) * scale  # [bq, bk]
     q_pos = qi * cfg.block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
     k_pos = ki * cfg.block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    mask = k_pos < cfg.seq_k
-    if cfg.causal:
-        mask = jnp.logical_and(mask, q_pos >= k_pos)
-    s = jnp.where(mask, s, _NEG_BIG)
+    s = jnp.where(_pair_mask(q_pos, k_pos, cfg), s, _NEG_BIG)
     return jnp.exp(s - lse)  # [bq, bk]
 
 
@@ -197,13 +215,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    diag_ok = (
-        ki * cfg.block_k <= qi * cfg.block_q + cfg.block_q - 1
-        if cfg.causal
-        else True
-    )
-
-    @pl.when(diag_ok)
+    @pl.when(_block_relevant(qi, ki, cfg))
     def _block():
         q = q_ref[0]
         k = k_ref[0]
@@ -242,13 +254,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    diag_ok = (
-        ki * cfg.block_k <= qi * cfg.block_q + cfg.block_q - 1
-        if cfg.causal
-        else True
-    )
-
-    @pl.when(diag_ok)
+    @pl.when(_block_relevant(qi, ki, cfg))
     def _block():
         q = q_ref[0]
         k = k_ref[0]
@@ -404,9 +410,16 @@ def default_blocks(seq_k: int) -> tuple[int, int]:
     return _MEASURED_BLOCKS[key]
 
 
-def _prep_bshd(q, k, v, causal, block_q, block_k, interpret):
+def _prep_bshd(q, k, v, causal, block_q, block_k, interpret,
+               window=None):
     """Shared BSHD preprocessing: GQA broadcast, fold to [B*H, S, D], pad
     to block multiples.  Returns (qf, kf, vf, cfg, (b, hq, sq, d))."""
+    if window is not None:
+        if not causal:
+            raise ValueError("window= requires causal=True (the sliding "
+                             "window is a causal band)")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     if interpret is None:
         interpret = _default_interpret()
     b, sq, hq, d = q.shape
@@ -429,7 +442,7 @@ def _prep_bshd(q, k, v, causal, block_q, block_k, interpret):
     sq_pad = -(-sq // block_q) * block_q
     sk_pad = -(-sk // block_k) * block_k
     cfg = _Cfg(causal=causal, seq_q=sq, seq_k=sk, block_q=block_q,
-               block_k=block_k, interpret=interpret)
+               block_k=block_k, interpret=interpret, window=window)
 
     def fold(x):  # BSHD -> [B*H, S, D]
         x = jnp.swapaxes(x, 1, 2)
@@ -447,6 +460,7 @@ def flash_attention(
     v: jax.Array,
     *,
     causal: bool = False,
+    window: int | None = None,
     block_q: int | None = None,
     block_k: int | None = None,
     interpret: bool | None = None,
@@ -457,13 +471,18 @@ def flash_attention(
     tests compare against) while never materializing the [S, S] score
     matrix.  K/V may have fewer heads (GQA) — broadcast to Q's head count.
 
+    ``window`` (requires ``causal=True``) is Mistral-style sliding-window
+    attention: position q attends keys in ``(q - window, q]``.  Blocks
+    entirely outside the band are skipped at the grid level (fwd AND both
+    bwd passes), so compute scales O(S * window) instead of O(S^2 / 2).
+
     Block defaults resolve per-sequence from a live-v5e sweep
     (:func:`default_blocks`; BENCH_NOTES.md round-5 block sweep):
     512x2048 up to seq 8k, 1024x1024 at 16k+.  2048-wide q blocks
     exceed the VMEM budget and fail to compile.
     """
     qf, kf, vf, cfg, (b, hq, sq, d) = _prep_bshd(
-        q, k, v, causal, block_q, block_k, interpret
+        q, k, v, causal, block_q, block_k, interpret, window
     )
     of = _flash_core(qf, kf, vf, cfg)
     of = of[:, :sq]
