@@ -393,26 +393,52 @@ def bench_attention(args):
     heads, hd = 16, 128
     if args.get("sweep"):
         return _attention_block_sweep(args, heads, hd, on_tpu)
+    # window=N benches the sliding-window band (seqs > N show the
+    # O(S*window) grid-skip win; the xla rows band their mask too)
+    window = (int(args["window"]) or None) if "window" in args else None
     rows = []
-    for seq, batch in ((512, 16), (2048, 4), (8192, 1)):
+    seq_rows = ((512, 16), (2048, 4), (8192, 1))
+    if window:
+        seq_rows = ((2048, 4), (8192, 1), (16384, 1))
+    for seq, batch in seq_rows:
         key = jax.random.key(seq)
         kq, kk, kv = jax.random.split(key, 3)
         shape = (batch, seq, heads, hd)
         q = jax.random.normal(kq, shape, jnp.bfloat16)
         k = jax.random.normal(kk, shape, jnp.bfloat16)
         v = jax.random.normal(kv, shape, jnp.bfloat16)
-        flops = 0.5 * 12 * batch * heads * seq * seq * hd
+        # useful FLOPs: the causal half; with a window, only the band's
+        # (q, k) pairs count (both impls credited identically).  The
+        # no-window formula stays the historical 0.5*S^2 so canonical
+        # rows remain comparable with committed captures.
+        if window and window < seq:
+            pairs = window * seq - window * (window - 1) // 2
+            flops = 12 * batch * heads * pairs * hd
+        else:
+            flops = 0.5 * 12 * batch * heads * seq * seq * hd
 
-        impls = {"xla": lambda q_, k_, v_: xla_attention(
-            q_, k_, v_, causal=True)}
+        if window:
+            # the banded reference rides chunked_attention (identical
+            # numerics to xla_attention, O(block*S) memory): the plain
+            # einsum's [H, S, S] fp32 scores at the 16k row would be
+            # 17 GB — past a 16 GB v5e (round-5 review)
+            from torch_automatic_distributed_neural_network_tpu.ops.attention import (
+                chunked_attention,
+            )
+            impls = {"xla": lambda q_, k_, v_: chunked_attention(
+                q_, k_, v_, causal=True, window=window)}
+        else:
+            impls = {"xla": lambda q_, k_, v_: xla_attention(
+                q_, k_, v_, causal=True)}
         if on_tpu:
             from torch_automatic_distributed_neural_network_tpu.ops.flash_attention import (
                 flash_attention,
             )
             impls["flash"] = lambda q_, k_, v_: flash_attention(
-                q_, k_, v_, causal=True)
+                q_, k_, v_, causal=True, window=window)
 
-        row = {"seq": seq, "batch": batch}
+        row = {"seq": seq, "batch": batch,
+               **({"window": window} if window else {})}
         for name, fn in impls.items():
             def loss(q_, k_, v_):
                 return jnp.sum(fn(q_, k_, v_).astype(jnp.float32))

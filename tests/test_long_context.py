@@ -262,27 +262,28 @@ class TestSlidingWindow:
             rtol=1e-5, atol=1e-5)
         assert float(jnp.abs(out_w[:, -1] - out_full[:, -1]).max()) > 1e-3
 
-    def test_decode_beyond_window_raises(self):
-        from torch_automatic_distributed_neural_network_tpu.inference.decode import (  # noqa: E501
-            KVCache,
-            forward_cached,
+    def test_windowed_generate_matches_naive_loop(self):
+        # KV-cache decode bands the cached mask, so generation is exact
+        # BEYOND the window: prompt 6 + 10 new tokens crosses window=8
+        from torch_automatic_distributed_neural_network_tpu.inference import (
+            generate,
         )
-        from torch_automatic_distributed_neural_network_tpu.models import (
-            llama_config,
-        )
-
-        cfg = llama_config("test", max_seq_len=64, sliding_window=8,
-                           dtype=jnp.float32)
         from torch_automatic_distributed_neural_network_tpu.models import (
             Llama,
         )
 
         model = Llama("test", max_seq_len=64, sliding_window=8,
                       dtype=jnp.float32)
-        toks = jnp.zeros((1, 4), jnp.int32)
-        params = model.init(jax.random.key(0), toks)["params"]
-        ok_cache = KVCache.init(cfg, batch=1, max_len=8)
-        forward_cached(params, cfg, toks, ok_cache)  # within window: fine
-        big_cache = KVCache.init(cfg, batch=1, max_len=32)
-        with pytest.raises(NotImplementedError, match="sliding window"):
-            forward_cached(params, cfg, toks, big_cache)
+        toks = jnp.asarray(
+            np.random.RandomState(3).randint(0, 1024, (2, 6)), jnp.int32)
+        variables = model.init(jax.random.key(0), toks)
+        n_new = 10
+        out = generate(model, variables, toks, max_new_tokens=n_new,
+                       cache_dtype=jnp.float32)
+        # oracle: the TRAINING forward (banded attention) re-run per token
+        cur = toks
+        for _ in range(n_new):
+            logits = model.apply(variables, cur)
+            nxt = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
+            cur = jnp.concatenate([cur, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(cur))

@@ -70,12 +70,19 @@ class KVCache(NamedTuple):
         )
 
 
-def _cached_attention(q, k_cache, v_cache, q_pos, kv_len):
+def _cached_attention(q, k_cache, v_cache, q_pos, kv_len, window=None):
     """q: [B, T, H, hd] at absolute positions q_pos..q_pos+T-1;
     k/v_cache: [B, S_max, kvH, hd] with kv_len entries valid (the current
     chunk already written).  Causality over absolute positions is encoded
     in the mask; the numerics (GQA broadcast, fp32 softmax, mask bias)
-    are ops/attention.xla_attention's."""
+    are ops/attention.xla_attention's.
+
+    ``window`` bands the mask (sliding-window models): key j is visible
+    to query i iff ``i - window < j <= i`` — exactly the training
+    semantics, so windowed decode is correct at ANY total length.  The
+    cache still stores every key (O(total) memory, same as the dense
+    cache); a rolling O(window) buffer is a possible future optimization,
+    not a correctness requirement."""
     from ..ops.attention import xla_attention
 
     T = q.shape[1]
@@ -83,6 +90,8 @@ def _cached_attention(q, k_cache, v_cache, q_pos, kv_len):
     key_idx = jnp.arange(S)[None, :]
     q_idx = (q_pos + jnp.arange(T))[:, None]
     mask = (key_idx <= q_idx) & (key_idx < kv_len)  # [T, S]
+    if window is not None:
+        mask &= key_idx > q_idx - window
     return xla_attention(q, k_cache, v_cache, causal=False,
                          mask=mask[None, None])
 
@@ -183,16 +192,6 @@ def forward_cached(
     routed instead of dense FLOPs."""
     if moe_decode not in ("dense", "routed"):
         raise ValueError(f"unknown moe_decode {moe_decode!r}")
-    if cfg.sliding_window is not None and (
-            cache.k.shape[2] > cfg.sliding_window):
-        # the cache keeps every key, so cached attention is FULL causal —
-        # exact only while total length stays inside the window; beyond
-        # it a rolling-buffer cache would be needed
-        raise NotImplementedError(
-            f"KV-cache decode beyond the sliding window is not supported "
-            f"(window={cfg.sliding_window}, cache max_len="
-            f"{cache.k.shape[2]}); cap prompt+new tokens at the window"
-        )
     if "layers" not in params:
         raise ValueError(
             "forward_cached needs the scanned parameter layout (a stacked "
@@ -226,7 +225,8 @@ def forward_cached(
             k_cache, k.astype(k_cache.dtype), pos0, axis=1)
         v_cache = jax.lax.dynamic_update_slice_in_dim(
             v_cache, v.astype(v_cache.dtype), pos0, axis=1)
-        o = _cached_attention(q, k_cache, v_cache, pos0, pos0 + T)
+        o = _cached_attention(q, k_cache, v_cache, pos0, pos0 + T,
+                              window=cfg.sliding_window)
         x = x + attn.apply(
             {"params": lp["attn"]}, o.astype(dtype), method="out_proj"
         )

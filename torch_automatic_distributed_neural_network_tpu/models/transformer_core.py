@@ -50,8 +50,8 @@ class TransformerConfig:
     # Mistral-style sliding-window attention: position q attends keys in
     # (q - window, q].  None = full causal.  Native in the Pallas flash
     # kernel (out-of-band blocks skipped at the grid level) and the
-    # xla/chunked paths; unsupported under cp (ring/ulysses) and in the
-    # KV-cache decode path beyond the window (both raise).
+    # xla/chunked paths; KV-cache decode bands the cached mask, exact at
+    # any total length.  Unsupported under cp (ring/ulysses) — raises.
     sliding_window: int | None = None
     # 'post' = original-transformer/BERT residual order
     # (norm AFTER the residual add); 'pre' = GPT-2/Llama

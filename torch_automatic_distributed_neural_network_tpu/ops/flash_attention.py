@@ -36,6 +36,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .attention import _check_window
+
 _NEG_BIG = -0.7 * float(np.finfo(np.float32).max)
 _LANES = 128  # TPU lane width: scratch row-stats are stored broadcast
 
@@ -414,12 +416,7 @@ def _prep_bshd(q, k, v, causal, block_q, block_k, interpret,
                window=None):
     """Shared BSHD preprocessing: GQA broadcast, fold to [B*H, S, D], pad
     to block multiples.  Returns (qf, kf, vf, cfg, (b, hq, sq, d))."""
-    if window is not None:
-        if not causal:
-            raise ValueError("window= requires causal=True (the sliding "
-                             "window is a causal band)")
-        if window < 1:
-            raise ValueError(f"window must be >= 1, got {window}")
+    _check_window(window, causal)
     if interpret is None:
         interpret = _default_interpret()
     b, sq, hq, d = q.shape
