@@ -21,6 +21,13 @@ from .metrics import MetricsLogger, peak_flops_per_chip, transformer_step_flops
 from .precision import Precision, resolve as resolve_precision
 
 _LAZY = {
+    "LoraSpec": "lora",
+    "LoraTarget": "lora",
+    "init_lora_params": "lora",
+    "merge_lora": "lora",
+    "lora_init_fn": "lora",
+    "lora_loss": "lora",
+    "lora_optimizer": "lora",
     "adamw_cosine": "optim",
     "warmup_cosine": "optim",
     "CheckpointManager": "checkpoint",

@@ -1,0 +1,208 @@
+"""LoRA: low-rank adapter fine-tuning on frozen base weights.
+
+Parameter-efficient fine-tuning for imported checkpoints (the
+``import_hf_*`` / ``from_torch`` migration path): every kernel whose
+tree path matches a target gets a pair of low-rank factors
+``a [.., d_in, r]`` / ``b [.., r, d_out]``, the effective weight is
+``W + (alpha / r) * a @ b``, and ONLY the factors train.
+
+Kernels are factorized in their MATRIX view: a target names how many
+trailing dims are the input/output features (DenseGeneral q/k/v kernels
+are ``[.., d_model, heads, hd]`` — one input dim, two output dims;
+o_proj is ``[.., heads, hd, d_model]`` — the mirror), those dims are
+flattened to ``d_in x d_out`` for the rank-r factors, and everything
+earlier (scan-stacked layer dims, expert banks) broadcasts.  Getting
+this wrong is not cosmetic: naively factoring only the LAST two dims of
+a 4-D attention kernel builds per-d_model-row factors 2x LARGER than
+the frozen weight itself (round-5 review).
+
+The integration is purely functional — no AutoDistribute changes:
+
+    base = import_hf_gpt2(hf)[1]["params"]          # frozen
+    spec = LoraSpec(rank=8)                          # q_proj + v_proj
+    ad = tad.AutoDistribute(
+        model,
+        optimizer=lora_optimizer(optax.adamw(1e-4)),
+        loss_fn=lora_loss(next_token_loss, spec),
+        init_fn=lora_init_fn(base, spec),
+        strategy="fsdp",
+    )
+
+``init_fn`` builds the combined ``{"base": ..., "lora": ...}`` tree;
+``lora_loss`` merges before every forward (XLA fuses the rank-r matmul
+into the weight load); ``lora_optimizer`` routes 'base' through
+``optax.set_to_zero`` — zero update AND zero optimizer state, so Adam
+moments exist only for the adapters, and XLA dead-code-eliminates the
+unused base-gradient materialization.  ``merge_lora`` folds trained
+adapters back into plain weights for export (``export_hf_*``) or
+full-speed serving.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..planner import path_str
+
+
+@dataclasses.dataclass(frozen=True)
+class LoraTarget:
+    """One adapted-kernel pattern: ``in_dims`` trailing-input dims then
+    ``out_dims`` trailing-output dims; anything earlier broadcasts."""
+
+    pattern: str
+    in_dims: int = 1
+    out_dims: int = 1
+
+
+# The core's attention kernels in their DenseGeneral shapes
+_Q_LIKE = LoraTarget(r"(q_proj|k_proj|v_proj)/kernel", 1, 2)
+_O_LIKE = LoraTarget(r"o_proj/kernel", 2, 1)
+_MLP_LIKE = LoraTarget(r"(up_proj|gate_proj|down_proj)/kernel", 1, 1)
+
+
+@dataclasses.dataclass(frozen=True)
+class LoraSpec:
+    rank: int = 8
+    alpha: float = 16.0
+    # the classic LoRA attention recipe by default; plain-string entries
+    # mean 2-D [in, out] kernels (bridged/custom models)
+    targets: Sequence[LoraTarget | str] = (
+        LoraTarget(r"q_proj/kernel", 1, 2),
+        LoraTarget(r"v_proj/kernel", 1, 2),
+    )
+    # factor-a init scale (b starts at zero so step 0 is exactly the
+    # base model)
+    init_scale: float = 0.01
+
+    @property
+    def scaling(self) -> float:
+        return self.alpha / self.rank
+
+    def resolve(self, path: str) -> LoraTarget | None:
+        for t in self.targets:
+            if isinstance(t, str):
+                t = LoraTarget(t)
+            if re.search(t.pattern, path):
+                return t
+        return None
+
+
+def _matrix_view(shape, target: LoraTarget):
+    """(lead dims, d_in, d_out) of a kernel under ``target``'s split."""
+    n = target.in_dims + target.out_dims
+    if len(shape) < n:
+        raise ValueError(
+            f"kernel shape {shape} has fewer dims than the target's "
+            f"in_dims+out_dims={n} ({target})"
+        )
+    lead = shape[: len(shape) - n]
+    d_in = int(np.prod(shape[len(shape) - n: len(shape) - target.out_dims]))
+    d_out = int(np.prod(shape[len(shape) - target.out_dims:]))
+    return lead, d_in, d_out
+
+
+def init_lora_params(rng, base_params, spec: LoraSpec):
+    """A/B factor tree for every kernel leaf matching ``spec``.
+
+    Returned tree mirrors the base structure but keeps ONLY matched
+    leaves, each replaced by ``{"a": [.., d_in, r], "b": [.., r, d_out]}``
+    in the target's matrix view.  Raises if nothing matches — a silent
+    no-adapter config would train nothing.
+    """
+    flat = jax.tree_util.tree_flatten_with_path(base_params)[0]
+    out: dict = {}
+    n = 0
+    for path, leaf in flat:
+        p = path_str(path)
+        target = spec.resolve(p)
+        if target is None or jnp.ndim(leaf) < 2:
+            continue
+        n += 1
+        rng, sub = jax.random.split(rng)
+        lead, d_in, d_out = _matrix_view(jnp.shape(leaf), target)
+        a = spec.init_scale * jax.random.normal(
+            sub, (*lead, d_in, spec.rank), jnp.float32
+        )
+        b = jnp.zeros((*lead, spec.rank, d_out), jnp.float32)
+        node = out
+        keys = p.split("/")
+        for k in keys[:-1]:
+            node = node.setdefault(k, {})
+        node[keys[-1]] = {"a": a, "b": b}
+    if n == 0:
+        raise ValueError(
+            f"LoraSpec targets {tuple(spec.targets)} matched no >=2-D "
+            "kernel in the base params — check the patterns against the "
+            "model's param paths"
+        )
+    return out
+
+
+def merge_lora(base_params, lora_params, spec: LoraSpec):
+    """base + scaling * a @ b on every adapted leaf (others pass through
+    by identity).  The rank-r contraction runs in fp32 and reshapes back
+    to the kernel's original (DenseGeneral) shape."""
+
+    def walk(base, lora, prefix):
+        if not isinstance(lora, dict):
+            return base
+        if set(lora) == {"a", "b"} and not isinstance(lora["a"], dict):
+            a, b = lora["a"], lora["b"]
+            delta = spec.scaling * jnp.einsum(
+                "...ir,...ro->...io", a.astype(jnp.float32),
+                b.astype(jnp.float32),
+            )
+            return (base.astype(jnp.float32)
+                    + delta.reshape(base.shape)).astype(base.dtype)
+        return {k: (walk(base[k], lora[k], f"{prefix}/{k}") if k in lora
+                    else base[k])
+                for k in base}
+
+    return walk(base_params, lora_params, "")
+
+
+def lora_init_fn(base_params, spec: LoraSpec) -> Callable:
+    """``init_fn`` for AutoDistribute: freeze ``base_params``, fresh
+    adapters.  The combined tree is ``{"base": ..., "lora": ...}``."""
+
+    def init(rng, batch):
+        del batch
+        return {"base": base_params,
+                "lora": init_lora_params(rng, base_params, spec)}
+
+    return init
+
+
+def lora_loss(loss_fn: Callable, spec: LoraSpec) -> Callable:
+    """Wrap an AutoDistribute loss_fn: merge adapters into the base
+    weights, then run the original loss on the merged tree."""
+
+    def wrapped(params, batch, rng, apply_fn):
+        merged = merge_lora(params["base"], params["lora"], spec)
+        return loss_fn(merged, batch, rng, apply_fn)
+
+    return wrapped
+
+
+def lora_optimizer(
+    inner: optax.GradientTransformation,
+) -> optax.GradientTransformation:
+    """Train adapters only: 'lora' leaves get ``inner``, 'base' leaves
+    ``optax.set_to_zero()`` — zero update and ZERO state, so no Adam
+    moments ever allocate for the frozen weights."""
+
+    def label(params):
+        return {"base": jax.tree.map(lambda _: "base", params["base"]),
+                "lora": jax.tree.map(lambda _: "lora", params["lora"])}
+
+    return optax.multi_transform(
+        {"lora": inner, "base": optax.set_to_zero()}, label
+    )
