@@ -702,20 +702,62 @@ def bench_decode(args):
         )
     state = ad.init(jax.random.key(0), data.batch(0))
 
+    quant_arg = str(args.get("quant", ""))
+    if quant_arg not in ("", "int8"):
+        # an unknown spelling must not silently benchmark the fp path
+        raise SystemExit(f"unknown quant={quant_arg!r}; supported: int8")
+    quant = quant_arg == "int8"
+    if quant and moe:
+        raise SystemExit("quant=int8 covers the dense decode path only "
+                         "(MoE expert banks are not in the quant table)")
+    if quant:
+        # weight-only int8 serving (inference/quant.py): params stored
+        # int8 + per-channel scales, dequantized inside the decode scan
+        # — the bandwidth-bound single-token steps stream ~4x fewer
+        # bytes (vs the fp32 state here; ~2x vs bf16 serving weights)
+        from torch_automatic_distributed_neural_network_tpu.inference import (
+            generate as generate_fn,
+        )
+        from torch_automatic_distributed_neural_network_tpu.inference.quant import (
+            quantize_for_decode,
+        )
+
+        qparams = quantize_for_decode(state.params)
+        nb = sum(x.nbytes for x in jax.tree.leaves(state.params))
+        nq = sum(x.nbytes for x in jax.tree.leaves(qparams))
+        log(f"quant=int8: weights {nb/2**20:.0f} -> {nq/2**20:.0f} MiB "
+            f"({nb/nq:.1f}x smaller)")
+        size = f"{size}_int8"
+        import functools
+
+        # jit per n_new (static), params as an ARGUMENT (not a baked-in
+        # constant) — the same whole-program-compiled regime as the
+        # ad.generate baseline, so the rows compare like for like
+        @functools.lru_cache(maxsize=4)
+        def _jitted(n_new):
+            return jax.jit(lambda qp, pr: generate_fn(
+                ad.model, {"params": qp}, pr, max_new_tokens=n_new,
+                **gen_kwargs))
+
+        def run_generate(prompt, n_new):
+            return _jitted(n_new)(qparams, prompt)
+    else:
+        def run_generate(prompt, n_new):
+            return ad.generate(state, prompt, max_new_tokens=n_new,
+                               **gen_kwargs)
+
     rows = []
     for batch in (1, 8):
         prompt = np.asarray(data.batch(0)["input_ids"])[:batch, :prompt_len]
         prompt = jax.numpy.asarray(prompt, dtype=jax.numpy.int32)
 
         def timed_generate(n_new, iters=3):
-            out = ad.generate(state, prompt, max_new_tokens=n_new,
-                              **gen_kwargs)
+            out = run_generate(prompt, n_new)
             np.asarray(out)  # warm: trace + compile + run (host readback fence)
             overhead = readback_overhead_s()
             t0 = time.perf_counter()
             for _ in range(iters):
-                out = ad.generate(state, prompt, max_new_tokens=n_new,
-                                  **gen_kwargs)
+                out = run_generate(prompt, n_new)
             np.asarray(out)  # ONE fence for the whole chain
             # overhead is one readback per MEASUREMENT, not per iteration
             return max(

@@ -1,0 +1,110 @@
+"""Weight-only int8 decode (inference/quant.py): quantization error
+bound, per-channel scale shapes, decode logits fidelity, and quantized
+generate vs the full-precision path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torch_automatic_distributed_neural_network_tpu.inference import (
+    KVCache,
+    forward_cached,
+    generate,
+)
+from torch_automatic_distributed_neural_network_tpu.inference.quant import (
+    dequantize_leaf,
+    is_quantized_leaf,
+    quantize_for_decode,
+)
+from torch_automatic_distributed_neural_network_tpu.models import (
+    GPT2,
+    Llama,
+)
+
+VOCAB = 512
+
+
+def _model_and_vars(family):
+    model = (GPT2("test", vocab_size=VOCAB, max_seq_len=64,
+                  dtype=jnp.float32) if family == "gpt2"
+             else Llama("test", max_seq_len=64, dtype=jnp.float32))
+    toks = jnp.zeros((2, 8), jnp.int32)
+    return model, model.init(jax.random.key(1), toks)
+
+
+def test_elementwise_error_bound():
+    # symmetric round-to-nearest: |W - dequant(W)| <= scale / 2
+    _, variables = _model_and_vars("gpt2")
+    q = quantize_for_decode(variables)
+    w = variables["params"]["layers"]["attn"]["q_proj"]["kernel"]
+    ql = q["params"]["layers"]["attn"]["q_proj"]["kernel"]
+    assert is_quantized_leaf(ql) and ql["q"].dtype == jnp.int8
+    # per-OUT-channel scales: reduce over d_model only
+    L, d, H, hd = w.shape
+    assert ql["scale"].shape == (L, 1, H, hd)
+    deq = dequantize_leaf(ql, jnp.float32)
+    err = jnp.abs(w - deq)
+    assert float(jnp.max(err - ql["scale"] / 2)) <= 1e-6
+
+
+def test_norms_and_biases_stay_fp32():
+    _, variables = _model_and_vars("gpt2")
+    q = quantize_for_decode(variables)["params"]
+    assert not is_quantized_leaf(q["layers"]["attn_norm"]["scale"])
+    assert q["layers"]["attn"]["q_proj"]["bias"].dtype == jnp.float32
+    assert not is_quantized_leaf(q["final_norm"]["scale"])
+    # embeddings quantize per row
+    emb = q["embed"]["embedding"]
+    assert is_quantized_leaf(emb)
+    assert emb["scale"].shape == (VOCAB, 1)
+
+
+def test_bytes_shrink():
+    _, variables = _model_and_vars("llama")
+    q = quantize_for_decode(variables)
+    nb = sum(x.nbytes for x in jax.tree.leaves(variables["params"]))
+    nq = sum(x.nbytes for x in jax.tree.leaves(q["params"]))
+    # fp32 storage here -> int8 + scales is ~4x smaller (bf16 serving
+    # weights would be ~2x); scales and norms keep it from exactly 4x
+    assert nq < 0.35 * nb, (nq, nb)
+
+
+@pytest.mark.parametrize("family", ["gpt2", "llama"])
+def test_decode_logits_track_full_precision(family):
+    model, variables = _model_and_vars(family)
+    q = quantize_for_decode(variables)
+    toks = jnp.asarray(
+        np.random.RandomState(0).randint(0, VOCAB, (2, 12)), jnp.int32)
+    lf, _ = forward_cached(variables["params"], model.cfg, toks,
+                           KVCache.init(model.cfg, 2, 32, jnp.float32))
+    lq, _ = forward_cached(q["params"], model.cfg, toks,
+                           KVCache.init(model.cfg, 2, 32, jnp.float32))
+    rng = float(jnp.abs(lf).max())
+    diff = float(jnp.abs(lf - lq).max())
+    assert diff < 0.05 * rng, (diff, rng)
+    cos = float((lf.ravel() @ lq.ravel())
+                / (jnp.linalg.norm(lf) * jnp.linalg.norm(lq)))
+    assert cos > 0.999, cos
+
+
+def test_quantized_generate_runs_and_is_deterministic():
+    model, variables = _model_and_vars("gpt2")
+    q = quantize_for_decode(variables)
+    toks = jnp.asarray(
+        np.random.RandomState(3).randint(0, VOCAB, (2, 6)), jnp.int32)
+    a = generate(model, q, toks, max_new_tokens=8, cache_dtype=jnp.float32)
+    b = generate(model, q, toks, max_new_tokens=8, cache_dtype=jnp.float32)
+    assert a.shape == (2, 14)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(a[:, :6]), np.asarray(toks))
+
+
+def test_double_quantization_is_identity():
+    # re-quantizing an already-quantized tree must not touch the leaves
+    _, variables = _model_and_vars("gpt2")
+    q1 = quantize_for_decode(variables)
+    q2 = quantize_for_decode(q1)
+    a = q1["params"]["layers"]["attn"]["q_proj"]["kernel"]
+    b = q2["params"]["layers"]["attn"]["q_proj"]["kernel"]
+    np.testing.assert_array_equal(np.asarray(a["q"]), np.asarray(b["q"]))

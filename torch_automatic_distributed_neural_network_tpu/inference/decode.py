@@ -50,6 +50,12 @@ from ..models.transformer_core import (
     make_norm,
 )
 from ..parallel.expert import expert_mlp
+from .quant import (
+    dequantize_leaf,
+    dequantize_tree,
+    embedding_lookup,
+    is_quantized_leaf,
+)
 
 
 class KVCache(NamedTuple):
@@ -209,7 +215,7 @@ def forward_cached(
     attn = SelfAttention(cfg)
     mlp = MLPBlock(cfg)
 
-    x = params["embed"]["embedding"].astype(dtype)[tokens]
+    x = embedding_lookup(params["embed"]["embedding"], tokens, dtype)
     positions = pos0 + jnp.arange(T)[None, :]
     if cfg.pos == "learned":
         pe = params["pos_embed"].astype(dtype)
@@ -217,6 +223,10 @@ def forward_cached(
 
     def layer(x, layer_params_and_kv):
         lp, k_cache, v_cache = layer_params_and_kv
+        # int8 weight-only decode: dequantize INSIDE the scan body so
+        # only this layer's weights convert per step — the stacked int8
+        # arrays are what lives in HBM (inference/quant.py)
+        lp = dequantize_tree(lp, dtype)
         h = norm.apply({"params": lp["attn_norm"]}, x)
         q, k, v = attn.apply(
             {"params": lp["attn"]}, h, positions, method="qkv"
@@ -252,9 +262,15 @@ def forward_cached(
     x = norm.apply({"params": params["final_norm"]}, x)
     last = x[:, -1].astype(jnp.float32)
     if cfg.tie_embeddings:
-        logits = last @ params["embed"]["embedding"].astype(jnp.float32).T
+        emb = params["embed"]["embedding"]
+        if is_quantized_leaf(emb):
+            emb = dequantize_leaf(emb, jnp.float32)
+        logits = last @ emb.astype(jnp.float32).T
     else:
-        logits = last @ params["lm_head"]["kernel"].astype(jnp.float32)
+        head = params["lm_head"]["kernel"]
+        if is_quantized_leaf(head):
+            head = dequantize_leaf(head, jnp.float32)
+        logits = last @ head.astype(jnp.float32)
     new_cache = KVCache(k=new_k, v=new_v, length=pos0 + T)
     return logits, new_cache
 

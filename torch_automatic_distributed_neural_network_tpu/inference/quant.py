@@ -1,0 +1,111 @@
+"""Weight-only int8 quantization for KV-cached decode.
+
+Single-token decode is HBM-bandwidth-bound: every step streams the full
+parameter set through the chip to do rank-1 work.  Storing weights as
+int8 with per-output-channel fp32 scales halves that traffic vs bf16
+(the matmuls still run in bf16/fp32 — only the STORAGE is quantized,
+dequantized on the fly where XLA fuses the convert+scale into the
+weight load).
+
+    qvars = quantize_for_decode(variables)      # once, on host or device
+    out = generate(model, qvars, prompt, ...)   # decode reads int8
+
+Symmetric per-channel scheme: for a kernel in its matrix view
+``[.., d_in, d_out]`` the scale is ``max|W|`` over d_in per output
+channel / 127; embeddings scale per row (each row is both a lookup
+result and a tied-head output channel).  Norm scales/biases stay fp32 —
+they are O(d) and numerically load-bearing.
+
+The quantized tree swaps each targeted leaf for ``{"q": int8,
+"scale": fp32}`` (same tree shape otherwise), so nn.scan-stacked layer
+stacks slice through unchanged and ``forward_cached`` dequantizes
+per-layer INSIDE the scan body — the int8 arrays are what lives in HBM.
+
+Accuracy contract (pinned in tests/test_quant.py): elementwise
+``|W - dequant(W)| <= scale/2``, and decode logits track the
+full-precision path to <5% of their dynamic range (measured ~2% on
+the test models).  Training is NOT
+quantized — this is a serving-path feature (weight-only, like the
+standard int8 LLM-serving recipe).
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax
+import jax.numpy as jnp
+
+from ..planner import path_str
+from ..training.lora import KERNEL_MATRIX_VIEWS, matrix_view
+
+# Embeddings quantize per ROW (each row is both a lookup result and a
+# tied-head output channel); kernels share training/lora.py's
+# matrix-view table — ONE definition of the kernel-family split.
+_EMBED_PAT = re.compile(r"(embed|seg_embed)/embedding$")
+
+
+def is_quantized_leaf(x) -> bool:
+    return isinstance(x, dict) and set(x) == {"q", "scale"}
+
+
+def _quantize(leaf, reduce_axes):
+    """Symmetric int8 with per-channel scales over ``reduce_axes``."""
+    w = jnp.asarray(leaf, jnp.float32)
+    amax = jnp.max(jnp.abs(w), axis=reduce_axes, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return {"q": q, "scale": scale.astype(jnp.float32)}
+
+
+def quantize_for_decode(variables):
+    """variables (or a bare params tree) -> same tree with every known
+    kernel/embedding leaf swapped for ``{"q", "scale"}``.  Leaves the
+    rest (norms, biases, already-quantized leaves) untouched."""
+    bare = not (isinstance(variables, dict) and "params" in variables)
+    params = variables if bare else variables["params"]
+
+    def visit(path, leaf):
+        if is_quantized_leaf(leaf) or jnp.ndim(leaf) < 2:
+            return leaf
+        p = path_str(path)
+        if _EMBED_PAT.search(p):  # [V, d] -> scale [V, 1]
+            return _quantize(leaf, (jnp.ndim(leaf) - 1,))
+        for target in KERNEL_MATRIX_VIEWS:
+            if re.search(target.pattern, p):
+                # reduce over the target's input dims; lead dims derive
+                # from the shape (lora.matrix_view), so scanned stacks
+                # and unstacked kernels both resolve without heuristics
+                lead, _, _ = matrix_view(jnp.shape(leaf), target)
+                n_lead = len(lead)
+                return _quantize(
+                    leaf, tuple(range(n_lead, n_lead + target.in_dims)))
+        return leaf
+
+    qparams = jax.tree_util.tree_map_with_path(
+        visit, params, is_leaf=is_quantized_leaf)
+    return qparams if bare else {**variables, "params": qparams}
+
+
+def dequantize_leaf(x, dtype=jnp.bfloat16):
+    """{"q", "scale"} -> dense array (XLA fuses the convert + scale into
+    the consuming matmul, so HBM traffic stays int8)."""
+    return (x["q"].astype(jnp.float32) * x["scale"]).astype(dtype)
+
+
+def dequantize_tree(tree, dtype=jnp.bfloat16):
+    """Replace every quantized leaf in a (sub)tree with its dense form."""
+    if is_quantized_leaf(tree):
+        return dequantize_leaf(tree, dtype)
+    if isinstance(tree, dict):
+        return {k: dequantize_tree(v, dtype) for k, v in tree.items()}
+    return tree
+
+
+def embedding_lookup(emb, tokens, dtype=jnp.bfloat16):
+    """Gather-then-dequantize: only the LOOKED-UP rows convert, the
+    [V, d] table itself stays int8 in HBM."""
+    if is_quantized_leaf(emb):
+        rows = emb["q"][tokens].astype(jnp.float32)
+        return (rows * emb["scale"][tokens]).astype(dtype)
+    return emb[tokens].astype(dtype)

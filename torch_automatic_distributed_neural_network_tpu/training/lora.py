@@ -62,10 +62,14 @@ class LoraTarget:
     out_dims: int = 1
 
 
-# The core's attention kernels in their DenseGeneral shapes
-_Q_LIKE = LoraTarget(r"(q_proj|k_proj|v_proj)/kernel", 1, 2)
-_O_LIKE = LoraTarget(r"o_proj/kernel", 2, 1)
-_MLP_LIKE = LoraTarget(r"(up_proj|gate_proj|down_proj)/kernel", 1, 1)
+# The core's kernel families in their DenseGeneral shapes — ONE table
+# for every consumer that needs the matrix view (LoRA factorization
+# here; per-out-channel int8 scales in inference/quant.py)
+Q_LIKE = LoraTarget(r"(q_proj|k_proj|v_proj)/kernel$", 1, 2)
+O_LIKE = LoraTarget(r"o_proj/kernel$", 2, 1)
+MLP_LIKE = LoraTarget(r"(up_proj|gate_proj|down_proj)/kernel$", 1, 1)
+HEAD_LIKE = LoraTarget(r"lm_head/kernel$", 1, 1)
+KERNEL_MATRIX_VIEWS = (Q_LIKE, O_LIKE, MLP_LIKE, HEAD_LIKE)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -95,8 +99,10 @@ class LoraSpec:
         return None
 
 
-def _matrix_view(shape, target: LoraTarget):
-    """(lead dims, d_in, d_out) of a kernel under ``target``'s split."""
+def matrix_view(shape, target: LoraTarget):
+    """(lead dims, d_in, d_out) of a kernel under ``target``'s split.
+    Lead dims derive from the SHAPE (len(shape) - in_dims - out_dims),
+    so scanned [L, ...] stacks and unstacked kernels both resolve."""
     n = target.in_dims + target.out_dims
     if len(shape) < n:
         raise ValueError(
@@ -127,7 +133,7 @@ def init_lora_params(rng, base_params, spec: LoraSpec):
             continue
         n += 1
         rng, sub = jax.random.split(rng)
-        lead, d_in, d_out = _matrix_view(jnp.shape(leaf), target)
+        lead, d_in, d_out = matrix_view(jnp.shape(leaf), target)
         a = spec.init_scale * jax.random.normal(
             sub, (*lead, d_in, spec.rank), jnp.float32
         )
