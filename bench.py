@@ -707,44 +707,27 @@ def bench_decode(args):
         # an unknown spelling must not silently benchmark the fp path
         raise SystemExit(f"unknown quant={quant_arg!r}; supported: int8")
     quant = quant_arg == "int8"
-    if quant and moe:
-        raise SystemExit("quant=int8 covers the dense decode path only "
-                         "(MoE expert banks are not in the quant table)")
     if quant:
-        # weight-only int8 serving (inference/quant.py): params stored
-        # int8 + per-channel scales, dequantized inside the decode scan
-        # — the bandwidth-bound single-token steps stream ~4x fewer
-        # bytes (vs the fp32 state here; ~2x vs bf16 serving weights)
-        from torch_automatic_distributed_neural_network_tpu.inference import (
-            generate as generate_fn,
-        )
+        # weight-only int8 serving (inference/quant.py): weights stream
+        # int8 through the bandwidth-bound decode steps (~4x fewer
+        # bytes than the fp32 state here; ~2x vs bf16 serving weights).
+        # ad.generate(quant=) quantizes inside the SAME jitted program
+        # as the fp baseline, so the rows compare like for like.
         from torch_automatic_distributed_neural_network_tpu.inference.quant import (
             quantize_for_decode,
         )
 
-        qparams = quantize_for_decode(state.params)
         nb = sum(x.nbytes for x in jax.tree.leaves(state.params))
-        nq = sum(x.nbytes for x in jax.tree.leaves(qparams))
+        nq = sum(x.nbytes for x in jax.tree.leaves(
+            quantize_for_decode(state.params)))
         log(f"quant=int8: weights {nb/2**20:.0f} -> {nq/2**20:.0f} MiB "
             f"({nb/nq:.1f}x smaller)")
         size = f"{size}_int8"
-        import functools
+        gen_kwargs["quant"] = "int8"
 
-        # jit per n_new (static), params as an ARGUMENT (not a baked-in
-        # constant) — the same whole-program-compiled regime as the
-        # ad.generate baseline, so the rows compare like for like
-        @functools.lru_cache(maxsize=4)
-        def _jitted(n_new):
-            return jax.jit(lambda qp, pr: generate_fn(
-                ad.model, {"params": qp}, pr, max_new_tokens=n_new,
-                **gen_kwargs))
-
-        def run_generate(prompt, n_new):
-            return _jitted(n_new)(qparams, prompt)
-    else:
-        def run_generate(prompt, n_new):
-            return ad.generate(state, prompt, max_new_tokens=n_new,
-                               **gen_kwargs)
+    def run_generate(prompt, n_new):
+        return ad.generate(state, prompt, max_new_tokens=n_new,
+                           **gen_kwargs)
 
     rows = []
     for batch in (1, 8):

@@ -108,3 +108,37 @@ def test_double_quantization_is_identity():
     a = q1["params"]["layers"]["attn"]["q_proj"]["kernel"]
     b = q2["params"]["layers"]["attn"]["q_proj"]["kernel"]
     np.testing.assert_array_equal(np.asarray(a["q"]), np.asarray(b["q"]))
+
+
+def test_autodistribute_generate_quant(devices8):
+    # plan-aware serving path: quant='int8' quantizes inside the jitted
+    # program, so TP/FSDP-sharded weights decode as int8 streams
+    import optax
+
+    import torch_automatic_distributed_neural_network_tpu as tad
+    from torch_automatic_distributed_neural_network_tpu.training import (
+        next_token_loss,
+    )
+
+    model = GPT2("test", vocab_size=VOCAB, max_seq_len=64,
+                 dtype=jnp.float32)
+    ad = tad.AutoDistribute(model, optimizer=optax.adamw(1e-3),
+                            loss_fn=next_token_loss, strategy="tp_fsdp")
+    toks = jnp.asarray(
+        np.random.RandomState(5).randint(0, VOCAB, (8, 17)), jnp.int32)
+    state = ad.init(jax.random.key(0), {"input_ids": np.asarray(toks)})
+    prompt = toks[:, :6]
+    a = ad.generate(state, prompt, max_new_tokens=6, cache_dtype=jnp.float32,
+                    quant="int8")
+    b = ad.generate(state, prompt, max_new_tokens=6, cache_dtype=jnp.float32,
+                    quant="int8")
+    assert a.shape == (8, 12)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(a[:, :6]), np.asarray(prompt))
+    # the sharded int8 path agrees with the unsharded pre-quantized one
+    q = quantize_for_decode({"params": jax.device_get(state.params)})
+    c = generate(model, q, prompt, max_new_tokens=6,
+                 cache_dtype=jnp.float32)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+    with pytest.raises(ValueError, match="quant"):
+        ad.generate(state, prompt, max_new_tokens=2, quant="int4")

@@ -842,6 +842,7 @@ class AutoDistribute:
         cache_dtype=jnp.bfloat16,
         eos_id: int | None = None,
         moe_decode: str = "dense",
+        quant: str | None = None,
     ):
         """Plan-aware autoregressive generation (inference/decode.py).
 
@@ -850,10 +851,21 @@ class AutoDistribute:
         FSDP), the prompt/output shard on the batch axes, and the KV
         cache is constrained to batch-on-data / heads-on-tensor
         (decode.cache_partition_spec).  Works for dense and MoE models.
+
+        ``quant='int8'`` quantizes the weights INSIDE the jitted program
+        (inference/quant.py) so the decode scan streams int8 — one
+        elementwise pass per call, trivial next to the decode loop; for
+        a long-lived serving process, pre-quantize once with
+        ``quantize_for_decode`` and call ``inference.generate`` instead.
+        MoE models quantize their dense kernels (attention, shared
+        projections); expert banks stay full precision in both
+        ``moe_decode`` modes.
         """
         from .inference import decode
 
         assert self.plan is not None, "call init() or build_plan() first"
+        if quant not in (None, "int8"):
+            raise ValueError(f"unknown quant={quant!r}; supported: 'int8'")
         if sample is None:
             sample = decode.SampleConfig(temperature=0.0)
         params = (
@@ -865,12 +877,17 @@ class AutoDistribute:
             rng = jax.random.key(0)
         mesh = self.plan.mesh
         key = (max_new_tokens, sample, str(jnp.dtype(cache_dtype)),
-               eos_id, moe_decode, tuple(getattr(prompt, "shape", ())))
+               eos_id, moe_decode, quant,
+               tuple(getattr(prompt, "shape", ())))
         cached = getattr(self, "_generate_cache", None)
         if cached is None:
             cached = self._generate_cache = {}
         if key not in cached:
             def run(params, prompt, rng):
+                if quant == "int8":
+                    from .inference.quant import quantize_for_decode
+
+                    params = quantize_for_decode(params)
                 return decode.generate(
                     self.model, {"params": params}, prompt,
                     max_new_tokens=max_new_tokens, sample=sample, rng=rng,
