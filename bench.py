@@ -711,23 +711,40 @@ def bench_decode(args):
         # weight-only int8 serving (inference/quant.py): weights stream
         # int8 through the bandwidth-bound decode steps (~4x fewer
         # bytes than the fp32 state here; ~2x vs bf16 serving weights).
-        # ad.generate(quant=) quantizes inside the SAME jitted program
-        # as the fp baseline, so the rows compare like for like.
+        # Pre-quantize ONCE (the long-lived-serving regime this bench
+        # models) and jit generate whole-program with the int8 params as
+        # ARGUMENTS — timing ad.generate(quant=) instead would re-read
+        # the full fp32 set for in-program quantization every call and
+        # understate the decode win (round-5 review, second pass).
+        import functools
+
+        from torch_automatic_distributed_neural_network_tpu.inference import (
+            generate as generate_fn,
+        )
         from torch_automatic_distributed_neural_network_tpu.inference.quant import (
             quantize_for_decode,
         )
 
+        qparams = quantize_for_decode(state.params)
         nb = sum(x.nbytes for x in jax.tree.leaves(state.params))
-        nq = sum(x.nbytes for x in jax.tree.leaves(
-            quantize_for_decode(state.params)))
+        nq = sum(x.nbytes for x in jax.tree.leaves(qparams))
         log(f"quant=int8: weights {nb/2**20:.0f} -> {nq/2**20:.0f} MiB "
             f"({nb/nq:.1f}x smaller)")
         size = f"{size}_int8"
-        gen_kwargs["quant"] = "int8"
 
-    def run_generate(prompt, n_new):
-        return ad.generate(state, prompt, max_new_tokens=n_new,
-                           **gen_kwargs)
+        @functools.lru_cache(maxsize=4)
+        def _jitted(n_new):
+            return jax.jit(lambda qp, pr: generate_fn(
+                ad.model, {"params": qp}, pr, max_new_tokens=n_new,
+                mesh=ad.plan.mesh if jax.device_count() > 1 else None,
+                **gen_kwargs))
+
+        def run_generate(prompt, n_new):
+            return _jitted(n_new)(qparams, prompt)
+    else:
+        def run_generate(prompt, n_new):
+            return ad.generate(state, prompt, max_new_tokens=n_new,
+                               **gen_kwargs)
 
     rows = []
     for batch in (1, 8):

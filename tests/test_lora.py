@@ -167,3 +167,48 @@ def test_lora_1_vs_8_device_parity(strategy, pretrained):
     _, ref = _finetune(base, data, jax.devices()[:1], "dp")
     _, got = _finetune(base, data, jax.devices(), strategy)
     np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_lora_checkpoint_resume_parity(pretrained, tmp_path):
+    # the combined {"base", "lora"} state checkpoints and resumes like
+    # any TrainState: 4 straight lora steps == 2 + save/restore + 2
+    from torch_automatic_distributed_neural_network_tpu.training import (
+        CheckpointManager,
+        abstract_state_for,
+    )
+
+    base, data = pretrained
+
+    def make_ad():
+        return tad.AutoDistribute(
+            tiny(),
+            optimizer=lora_optimizer(optax.adamw(3e-3)),
+            loss_fn=lora_loss(next_token_loss, _SPEC),
+            init_fn=lora_init_fn(base, _SPEC),
+            strategy="fsdp",
+        )
+
+    ad = make_ad()
+    s = ad.init(jax.random.key(2), data.batch(30))
+    for i in range(30, 34):
+        s, _ = ad.step(s, data.batch(i))
+    straight = jax.tree.leaves(s.params["lora"])
+
+    ad1 = make_ad()
+    s1 = ad1.init(jax.random.key(2), data.batch(30))
+    for i in range(30, 32):
+        s1, _ = ad1.step(s1, data.batch(i))
+    ckpt = CheckpointManager(str(tmp_path / "lora_ckpt"))
+    ckpt.save(2, s1)
+    ckpt.close()
+
+    ad2 = make_ad()
+    ckpt2 = CheckpointManager(str(tmp_path / "lora_ckpt"))
+    abstract = abstract_state_for(ad2, jax.random.key(2), data.batch(30))
+    s2 = ckpt2.restore(abstract)
+    ad2._compile_step(abstract, ad2.state_shardings(abstract))
+    for i in range(32, 34):
+        s2, _ = ad2.step(s2, data.batch(i))
+    ckpt2.close()
+    for a, b in zip(straight, jax.tree.leaves(s2.params["lora"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)

@@ -142,3 +142,37 @@ def test_autodistribute_generate_quant(devices8):
     np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
     with pytest.raises(ValueError, match="quant"):
         ad.generate(state, prompt, max_new_tokens=2, quant="int4")
+
+
+def test_moe_expert_banks_stay_full_precision(devices8):
+    # the MoE exemption is name-based; pin it so a rename can't silently
+    # quantize expert banks and shift both moe_decode modes' numerics
+    import optax
+
+    import torch_automatic_distributed_neural_network_tpu as tad
+    from torch_automatic_distributed_neural_network_tpu.models import MoE
+    from torch_automatic_distributed_neural_network_tpu.training import (
+        moe_next_token_loss,
+    )
+
+    model = MoE("test", vocab_size=VOCAB, max_seq_len=64)
+    ad = tad.AutoDistribute(model, optimizer=optax.sgd(1e-3),
+                            loss_fn=moe_next_token_loss, strategy="dp")
+    toks = np.random.RandomState(7).randint(0, VOCAB, (8, 17)).astype(
+        np.int32)
+    state = ad.init(jax.random.key(0), {"input_ids": toks})
+    q = quantize_for_decode(jax.device_get(state.params))
+    mlp = q["layers"]["mlp"]
+    assert not is_quantized_leaf(mlp["experts_up"])
+    assert not is_quantized_leaf(mlp["experts_down"])
+    assert not is_quantized_leaf(mlp["router"]["kernel"])
+    # attention kernels DO quantize
+    assert is_quantized_leaf(q["layers"]["attn"]["q_proj"]["kernel"])
+    # and the plan-aware quantized path decodes in routed mode
+    prompt = jnp.asarray(toks[:, :6])
+    a = ad.generate(state, prompt, max_new_tokens=4, quant="int8",
+                    moe_decode="routed", cache_dtype=jnp.float32)
+    b = ad.generate(state, prompt, max_new_tokens=4, quant="int8",
+                    moe_decode="routed", cache_dtype=jnp.float32)
+    assert a.shape == (8, 10)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

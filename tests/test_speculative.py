@@ -1,0 +1,95 @@
+"""Greedy speculative decoding (inference/speculative.py): the output
+must be BIT-IDENTICAL to plain greedy decoding of the target alone, for
+any draft — a bad draft costs speed, never correctness."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torch_automatic_distributed_neural_network_tpu.inference import (
+    generate,
+    speculative_generate,
+)
+from torch_automatic_distributed_neural_network_tpu.models import (
+    GPT2,
+    Llama,
+)
+
+VOCAB = 256
+
+
+def _target_and_prompt(family="gpt2"):
+    model = (GPT2("test", vocab_size=VOCAB, max_seq_len=128,
+                  dtype=jnp.float32) if family == "gpt2"
+             else Llama("test", vocab_size=VOCAB, max_seq_len=128,
+                        dtype=jnp.float32))
+    toks = jnp.asarray(
+        np.random.RandomState(0).randint(0, VOCAB, (1, 10)), jnp.int32)
+    return model, model.init(jax.random.key(1), toks), toks
+
+
+@pytest.mark.parametrize("k", [1, 3, 4])
+def test_self_draft_exact(k):
+    # draft == target: every proposal accepted, output still exact
+    model, tv, toks = _target_and_prompt()
+    ref = generate(model, tv, toks, max_new_tokens=17,
+                   cache_dtype=jnp.float32)
+    out = speculative_generate(model, tv, model, tv, toks,
+                               max_new_tokens=17, k=k,
+                               cache_dtype=jnp.float32)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+@pytest.mark.parametrize("family", ["gpt2", "llama"])
+def test_unrelated_draft_exact(family):
+    # a random-init draft disagrees constantly; exactness must survive
+    # every partial-accept / rollback path
+    model, tv, toks = _target_and_prompt(family)
+    draft = (GPT2("test", vocab_size=VOCAB, max_seq_len=128, n_layers=1,
+                  dtype=jnp.float32) if family == "gpt2"
+             else Llama("test", vocab_size=VOCAB, max_seq_len=128,
+                        n_layers=1, dtype=jnp.float32))
+    dv = draft.init(jax.random.key(99), toks)
+    ref = generate(model, tv, toks, max_new_tokens=20,
+                   cache_dtype=jnp.float32)
+    out = speculative_generate(model, tv, draft, dv, toks,
+                               max_new_tokens=20, k=4,
+                               cache_dtype=jnp.float32)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_short_generations_and_validation():
+    model, tv, toks = _target_and_prompt()
+    # max_new smaller than k: the overshoot slices away exactly
+    ref = generate(model, tv, toks, max_new_tokens=2,
+                   cache_dtype=jnp.float32)
+    out = speculative_generate(model, tv, model, tv, toks,
+                               max_new_tokens=2, k=4,
+                               cache_dtype=jnp.float32)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    with pytest.raises(NotImplementedError, match="batch 1"):
+        speculative_generate(model, tv, model, tv,
+                             jnp.zeros((2, 4), jnp.int32),
+                             max_new_tokens=4)
+    draft = GPT2("test", vocab_size=VOCAB * 2, max_seq_len=128,
+                 dtype=jnp.float32)
+    dv = draft.init(jax.random.key(0), toks)
+    with pytest.raises(ValueError, match="vocabular"):
+        speculative_generate(model, tv, draft, dv, toks, max_new_tokens=4)
+    with pytest.raises(ValueError, match="k must"):
+        speculative_generate(model, tv, model, tv, toks,
+                             max_new_tokens=4, k=0)
+
+
+def test_headroom_validation():
+    # learned-pos models must have k+1 positions of slack past the last
+    # emitted token, or the clamped position slice would silently break
+    # exactness — reject instead
+    model = GPT2("test", vocab_size=VOCAB, max_seq_len=16,
+                 dtype=jnp.float32)
+    toks = jnp.zeros((1, 8), jnp.int32)
+    tv = model.init(jax.random.key(0), toks)
+    with pytest.raises(ValueError, match="headroom"):
+        speculative_generate(model, tv, model, tv, toks,
+                             max_new_tokens=8, k=4)

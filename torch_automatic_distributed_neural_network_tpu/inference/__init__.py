@@ -1,5 +1,8 @@
 """Inference: KV-cached autoregressive decoding for the decoder families."""
 
 from .decode import KVCache, SampleConfig, forward_cached, generate
+from .quant import quantize_for_decode
+from .speculative import speculative_generate
 
-__all__ = ["KVCache", "SampleConfig", "forward_cached", "generate"]
+__all__ = ["KVCache", "SampleConfig", "forward_cached", "generate",
+           "quantize_for_decode", "speculative_generate"]

@@ -186,9 +186,11 @@ def forward_cached(
     moe_decode: str = "dense",  # 'dense' | 'routed' (capacity-based)
     moe_capacity: int | None = None,  # pin the training group's capacity
     mesh=None,
+    all_logits: bool = False,
 ) -> tuple[jax.Array, KVCache]:
     """Run the decoder on a chunk against the cache; returns (logits of
-    the chunk's last position [B, vocab], updated cache).
+    the chunk's last position [B, vocab] — or of every position
+    [B, T, vocab] with ``all_logits=True`` — and the updated cache).
 
     ``moe_decode='dense'`` (default) runs every expert on the chunk and
     combines with the gates — exact in no-drop configs and cheapest for
@@ -260,17 +262,19 @@ def forward_cached(
     )
 
     x = norm.apply({"params": params["final_norm"]}, x)
-    last = x[:, -1].astype(jnp.float32)
+    # all_logits=True: logits at EVERY chunk position (speculative
+    # verification reads the whole chunk); default: last position only
+    feats = (x if all_logits else x[:, -1]).astype(jnp.float32)
     if cfg.tie_embeddings:
         emb = params["embed"]["embedding"]
         if is_quantized_leaf(emb):
             emb = dequantize_leaf(emb, jnp.float32)
-        logits = last @ emb.astype(jnp.float32).T
+        logits = feats @ emb.astype(jnp.float32).T
     else:
         head = params["lm_head"]["kernel"]
         if is_quantized_leaf(head):
             head = dequantize_leaf(head, jnp.float32)
-        logits = last @ head.astype(jnp.float32)
+        logits = feats @ head.astype(jnp.float32)
     new_cache = KVCache(k=new_k, v=new_v, length=pos0 + T)
     return logits, new_cache
 

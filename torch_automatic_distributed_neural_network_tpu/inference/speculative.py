@@ -1,0 +1,144 @@
+"""Greedy speculative decoding: a small draft model proposes, the
+target verifies in one chunked forward.
+
+Single-token decode is latency-bound on the TARGET's weight streaming;
+speculative decoding amortizes it: the draft greedily proposes ``k``
+tokens (k cheap steps), the target runs ONE (k+1)-token cached forward
+over the proposal, and the longest prefix where the target's own greedy
+choices agree is accepted — plus the target's next token as a bonus, so
+every round emits between 1 and k+1 tokens with exactly one target
+chunk.
+
+The greedy variant's contract is EXACT EQUALITY: the emitted sequence
+is bit-identical to what plain greedy decoding of the target alone
+would produce, for ANY draft model — a bad draft only costs speed
+(acceptance rate), never correctness.  tests/test_speculative.py pins
+this with both a self-draft (always accepts) and an unrelated
+random-init draft (rarely accepts).
+
+Both models run through the same :func:`..inference.decode.
+forward_cached` as everything else (sliding windows, GQA, int8-
+quantized params all compose); cache roll-back after a partial accept
+is just ``length = n_accepted`` — entries past ``length`` are masked
+out of cached attention and overwritten by the next round.
+
+Scope: greedy only (temperature-0; the sampled variant needs the
+rejection-resampling scheme), batch 1 (accept counts are per-sequence),
+``eos_id`` unsupported.  The whole loop is one ``lax.while_loop``
+program: dynamic trip count, static shapes throughout.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .decode import KVCache, forward_cached
+
+
+def speculative_generate(
+    model,
+    variables,
+    draft_model,
+    draft_variables,
+    prompt: jax.Array,  # [1, P] int32
+    *,
+    max_new_tokens: int,
+    k: int = 4,
+    cache_dtype=jnp.bfloat16,
+) -> jax.Array:
+    """Greedy speculative generation; returns [1, P + max_new_tokens].
+
+    ``model``/``variables`` is the target (whose output this exactly
+    reproduces); ``draft_model``/``draft_variables`` the cheap proposer.
+    Both must share the tokenizer/vocab.
+    """
+    cfg, dcfg = model.cfg, draft_model.cfg
+    params = variables["params"]
+    dparams = draft_variables["params"]
+    if cfg.vocab_size != dcfg.vocab_size:
+        raise ValueError(
+            f"target and draft vocabularies differ "
+            f"({cfg.vocab_size} vs {dcfg.vocab_size})"
+        )
+    B, P = prompt.shape
+    if B != 1:
+        raise NotImplementedError(
+            "speculative decoding accepts batch 1 (accept counts are "
+            "per-sequence); vmap or loop over rows"
+        )
+    if max_new_tokens < 1:
+        return prompt
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+
+    # +k+1 slack: a round may overshoot max_new before the final slice
+    max_len = P + max_new_tokens + k + 1
+    for name, c in (("target", cfg), ("draft", dcfg)):
+        if c.pos == "learned" and c.max_seq_len < max_len:
+            # a verify chunk past the table would CLAMP the position
+            # slice and silently shift every chunk embedding — breaking
+            # the bit-exactness contract with no error
+            raise ValueError(
+                f"{name} max_seq_len={c.max_seq_len} < prompt + "
+                f"max_new_tokens + k + 1 = {max_len}: speculative rounds "
+                f"need k+1 positions of headroom past the last emitted "
+                f"token (shorten the generation or rebuild the model "
+                f"with a larger max_seq_len)"
+            )
+    cache = KVCache.init(cfg, B, max_len, dtype=cache_dtype)
+    dcache = KVCache.init(dcfg, B, max_len, dtype=cache_dtype)
+
+    # Prefill both on the prompt; `last` = the one emitted-but-uncached
+    # token (invariant: caches hold keys for tokens[0..length-1])
+    logits, cache = forward_cached(params, cfg, prompt, cache)
+    first = jnp.argmax(logits, -1).astype(jnp.int32)  # [1]
+    _, dcache = forward_cached(dparams, dcfg, prompt, dcache)
+
+    out = jnp.zeros((B, max_new_tokens + k + 1), jnp.int32)
+    out = jax.lax.dynamic_update_slice(out, first[:, None], (0, 0))
+
+    def draft_step(carry, _):
+        dcache, tok = carry
+        lg, dcache = forward_cached(dparams, dcfg, tok[:, None], dcache)
+        nxt = jnp.argmax(lg, -1).astype(jnp.int32)
+        return (dcache, nxt), nxt
+
+    def round_body(state):
+        cache, dcache, out, n_emitted, last = state
+        # 1) draft proposes k greedy tokens continuing from `last`.
+        # k+1 scan steps, not k: the last step's OUTPUT is discarded but
+        # its input write puts d_k's key in the draft cache, so a
+        # full-accept round leaves the cache complete for the next one.
+        (dcache, _), drafts_all = jax.lax.scan(
+            draft_step, (dcache, last), None, length=k + 1)
+        drafts = drafts_all[:k, 0]  # [k] proposals d_1..d_k
+        # 2) target verifies [last, d_1..d_k] in ONE chunk
+        chunk = jnp.concatenate([last, drafts])[None, :]  # [1, k+1]
+        lg, cache = forward_cached(params, cfg, chunk, cache,
+                                   all_logits=True)  # [1, k+1, V]
+        t = jnp.argmax(lg[0], -1).astype(jnp.int32)  # [k+1] greedy targets
+        # 3) accept the longest prefix where draft_i == target_{i-1};
+        # appending a 0 makes argmin return k when every draft agrees
+        agree = drafts == t[:k]  # [k]
+        a = jnp.argmin(jnp.concatenate(
+            [agree.astype(jnp.int32), jnp.zeros((1,), jnp.int32)]))
+        # emitted this round: d_1..d_a then the bonus t_a  (a+1 tokens;
+        # positions past a hold t_a copies — overwritten next round or
+        # sliced off at the end)
+        d_pad = jnp.concatenate([drafts, jnp.zeros((1,), jnp.int32)])
+        emit = jnp.where(jnp.arange(k + 1) < a, d_pad, t[a])
+        out = jax.lax.dynamic_update_slice(
+            out, emit[None, :], (0, n_emitted))
+        new_last = t[a][None]
+        n_keys = cache.length - (k + 1) + a + 1  # roll back stale keys
+        cache = cache._replace(length=n_keys)
+        dcache = dcache._replace(length=jnp.minimum(dcache.length, n_keys))
+        return cache, dcache, out, n_emitted + a + 1, new_last
+
+    def cond(state):
+        return state[3] < max_new_tokens
+
+    state = (cache, dcache, out, jnp.ones((), jnp.int32), first)
+    *_, out, _, _ = jax.lax.while_loop(cond, round_body, state)
+    return jnp.concatenate([prompt, out[:, :max_new_tokens]], axis=1)
