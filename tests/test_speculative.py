@@ -93,3 +93,45 @@ def test_headroom_validation():
     with pytest.raises(ValueError, match="headroom"):
         speculative_generate(model, tv, model, tv, toks,
                              max_new_tokens=8, k=4)
+
+
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+
+@st.composite
+def spec_case(draw):
+    # shapes from a small fixed set (each distinct tuple costs a fresh
+    # XLA compile of two decode programs — round-5 review); seeds stay
+    # fully random, which is where the accept/rollback path diversity
+    # actually comes from
+    prompt_len, max_new, k = draw(st.sampled_from(
+        [(1, 9, 1), (7, 14, 4), (12, 11, 5)]))
+    return dict(
+        seed=draw(st.integers(0, 2**31 - 1)),
+        prompt_len=prompt_len,
+        max_new=max_new,
+        k=k,
+        draft_layers=draw(st.sampled_from([1, 2])),
+        draft_seed=draw(st.integers(0, 2**31 - 1)),
+    )
+
+
+@given(case=spec_case())
+@settings(max_examples=10, deadline=None)
+def test_exactness_fuzz(case):
+    # the bitwise contract under random prompt/k/draft geometry: every
+    # accept count and rollback path the case hits must stay exact
+    model = GPT2("test", vocab_size=VOCAB, max_seq_len=64,
+                 dtype=jnp.float32)
+    toks = jnp.asarray(np.random.RandomState(case["seed"]).randint(
+        0, VOCAB, (1, case["prompt_len"])), jnp.int32)
+    tv = model.init(jax.random.key(case["seed"] % 997), toks)
+    draft = GPT2("test", vocab_size=VOCAB, max_seq_len=64,
+                 n_layers=case["draft_layers"], dtype=jnp.float32)
+    dv = draft.init(jax.random.key(case["draft_seed"] % 997), toks)
+    ref = generate(model, tv, toks, max_new_tokens=case["max_new"],
+                   cache_dtype=jnp.float32)
+    out = speculative_generate(model, tv, draft, dv, toks,
+                               max_new_tokens=case["max_new"],
+                               k=case["k"], cache_dtype=jnp.float32)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
