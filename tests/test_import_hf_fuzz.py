@@ -15,6 +15,8 @@ transformers = pytest.importorskip("transformers")
 
 from torch_automatic_distributed_neural_network_tpu.models import (  # noqa: E402
     import_hf_bert,
+    import_hf_gpt2,
+    import_hf_llama,
     import_hf_vit,
 )
 
@@ -91,4 +93,71 @@ def test_vit_import_parity_fuzz(shape, seed):
         ref = hf(torch.tensor(img)).logits.numpy()
     got = np.asarray(model.apply(
         variables, jnp.asarray(img.transpose(0, 2, 3, 1))))
+    np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-3)
+
+
+@st.composite
+def llama_shape(draw):
+    head_dim = draw(st.sampled_from([8, 16]))
+    n_heads = draw(st.sampled_from([2, 4, 8]))
+    # GQA: kv heads divide query heads
+    n_kv = draw(st.sampled_from(
+        [d for d in (1, 2, 4, 8) if n_heads % d == 0]))
+    window = draw(st.sampled_from([None, 8, 16]))
+    return dict(
+        vocab_size=draw(st.integers(32, 200)),
+        hidden_size=n_heads * head_dim,
+        intermediate_size=draw(st.integers(16, 96)),
+        num_hidden_layers=draw(st.integers(1, 3)),
+        num_attention_heads=n_heads,
+        num_key_value_heads=n_kv,
+        max_position_embeddings=64,
+        rms_norm_eps=draw(st.sampled_from([1e-6, 1e-5])),
+        rope_theta=draw(st.sampled_from([1e4, 5e5, 1e6])),
+        tie_word_embeddings=draw(st.booleans()),
+    ), window
+
+
+@given(case=llama_shape(), seed=st.integers(0, 2**31 - 1))
+@settings(max_examples=12, deadline=None)
+def test_llama_mistral_import_parity_fuzz(case, seed):
+    # Llama and Mistral (sliding window) geometries through ONE
+    # importer: GQA head splits, eps, theta, tied/untied heads
+    shape, window = case
+    torch.manual_seed(seed)
+    if window is None:
+        hf = transformers.LlamaForCausalLM(
+            transformers.LlamaConfig(**shape)).eval()
+    else:
+        shape = dict(shape, sliding_window=window,
+                     attn_implementation="eager")
+        hf = transformers.MistralForCausalLM(
+            transformers.MistralConfig(**shape)).eval()
+    model, variables = import_hf_llama(hf, dtype=jnp.float32)
+    assert model.cfg.sliding_window == window
+    rng = np.random.RandomState(seed % 2**16)
+    toks = rng.randint(0, shape["vocab_size"], (2, 21))  # > window 8/16
+    with torch.no_grad():
+        ref = hf(torch.tensor(toks)).logits.numpy()
+    got = np.asarray(model.apply(variables, jnp.asarray(toks)))
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+
+
+@given(n_head=st.sampled_from([2, 4, 8]),
+       n_embd=st.sampled_from([64, 128]),
+       seed=st.integers(0, 2**31 - 1))
+@settings(max_examples=8, deadline=None)
+def test_gpt2_import_parity_fuzz(n_head, n_embd, seed):
+    cfg = transformers.GPT2Config(
+        vocab_size=120, n_positions=48, n_embd=n_embd, n_layer=2,
+        n_head=n_head, resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+    )
+    torch.manual_seed(seed)
+    hf = transformers.GPT2LMHeadModel(cfg).eval()
+    model, variables = import_hf_gpt2(hf, dtype=jnp.float32)
+    rng = np.random.RandomState(seed % 2**16)
+    toks = rng.randint(0, 120, (2, 13))
+    with torch.no_grad():
+        ref = hf(torch.tensor(toks)).logits.numpy()
+    got = np.asarray(model.apply(variables, jnp.asarray(toks)))
     np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-3)
