@@ -7,6 +7,8 @@ loop (inference/decode.py).
 Usage::
 
     python examples/generate_text.py model.size=small run.new_tokens=64
+    python examples/generate_text.py run.quant=int8       # int8 weights
+    python examples/generate_text.py run.speculative=1    # draft+verify
 """
 
 import dataclasses
@@ -47,6 +49,12 @@ class RunCfg:
     # ('tp', 'tp_fsdp', 'fsdp', 'dp') -> plan-aware sharded decode
     # (AutoDistribute.generate: sharded params, KV cache on the mesh)
     strategy: str = "none"
+    quant: str = "none"  # 'int8': weight-only quantized decode
+    # 1: greedy speculative decoding (batch 1, temperature ignored) —
+    # a 1-layer draft proposes, the full model verifies; output is
+    # bit-identical to plain greedy decoding of the full model
+    speculative: int = 0
+    spec_k: int = 4
 
 
 @dataclasses.dataclass(frozen=True)
@@ -59,11 +67,27 @@ def main():
     cfg: Cfg = cfglib.apply_overrides(Cfg(), sys.argv[1:])
     print(cfglib.to_json(cfg))
     r = cfg.run
+    if r.quant not in ("none", "int8"):
+        raise SystemExit(f"unknown run.quant={r.quant!r}; "
+                         "supported: none, int8")
+    if r.speculative and (r.strategy != "none" or r.quant != "none"
+                          or r.eos_id >= 0):
+        # a silently-dropped flag would attribute the tok/s line to a
+        # config that never ran
+        raise SystemExit("run.speculative=1 is plain greedy decode: it "
+                         "does not compose with run.strategy / "
+                         "run.quant / run.eos_id")
+    # speculative rounds need k+1 positions of headroom past the last
+    # emitted token; build the model ONCE with the right table size
+    seq_budget = r.prompt_len + r.new_tokens + (
+        r.spec_k + 1 if r.speculative else 0)
+    batch = 1 if r.speculative else r.batch_size
+    r = dataclasses.replace(r, batch_size=batch)
     model = GPT2(cfg.model.size, vocab_size=cfg.model.vocab_size,
-                 max_seq_len=r.prompt_len + r.new_tokens)
+                 max_seq_len=seq_budget)
     prompt = jnp.asarray(
         np.random.RandomState(0).randint(
-            0, cfg.model.vocab_size, size=(r.batch_size, r.prompt_len)),
+            0, cfg.model.vocab_size, size=(batch, r.prompt_len)),
         jnp.int32,
     )
     variables = model.init(jax.random.key(0), prompt)
@@ -71,7 +95,18 @@ def main():
     sample = SampleConfig(temperature=r.temperature, top_k=r.top_k,
                           top_p=r.top_p)
 
-    if r.strategy != "none":
+    if r.speculative:
+        from torch_automatic_distributed_neural_network_tpu.inference import (
+            speculative_generate,
+        )
+
+        draft = GPT2(cfg.model.size, vocab_size=cfg.model.vocab_size,
+                     max_seq_len=seq_budget, n_layers=1)
+        dv = draft.init(jax.random.key(7), prompt)
+        gen = jax.jit(lambda v, p, k: speculative_generate(
+            model, v, draft, dv, p, max_new_tokens=r.new_tokens,
+            k=r.spec_k))
+    elif r.strategy != "none":
         import optax
 
         import torch_automatic_distributed_neural_network_tpu as tad
@@ -92,8 +127,14 @@ def main():
               f"mesh={tad.mesh_degrees(ad.plan.mesh)}")
         gen = lambda v, p, k: ad.generate(
             v, p, max_new_tokens=r.new_tokens, sample=sample, rng=k,
-            eos_id=eos)
+            eos_id=eos, quant=None if r.quant == "none" else r.quant)
     else:
+        if r.quant == "int8":
+            from torch_automatic_distributed_neural_network_tpu.inference import (  # noqa: E501
+                quantize_for_decode,
+            )
+
+            variables = quantize_for_decode(variables)
         gen = jax.jit(lambda v, p, k: generate(
             model, v, p, max_new_tokens=r.new_tokens, sample=sample, rng=k,
             eos_id=eos))

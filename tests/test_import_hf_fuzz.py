@@ -1,8 +1,9 @@
-"""Property-based fuzzing of the encoder importers (import_hf_bert /
-import_hf_vit): random shape-valid HF configs must import with logits
-parity against the real transformers implementation — any silent
-mistranslation (head split, norm placement, eps, patch order) shows up
-as a numeric mismatch with a shrunk, replayable counterexample."""
+"""Property-based fuzzing of EVERY HF importer (bert/vit encoder
+layouts, gpt2, and the llama/mistral family): random shape-valid HF
+configs must import with logits parity against the real transformers
+implementation — any silent mistranslation (head split, GQA boundary,
+norm placement, eps, theta, sliding window, patch order) shows up as a
+numeric mismatch with a shrunk, replayable counterexample."""
 
 import jax
 import jax.numpy as jnp
