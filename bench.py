@@ -1220,6 +1220,24 @@ def _probe_backend(timeout_s: int = 300) -> str | None:
     return probe_backend(timeout_s)
 
 
+def _bench_journal():
+    """Append-mode journal of probe/tunnel incidents: every bench
+    invocation records whether the TPU was reachable and whether a stale
+    last-good number was substituted — so ``tadnn report`` can answer
+    "was that measurement live?" after the fact (round-5 review)."""
+    from torch_automatic_distributed_neural_network_tpu.obs.journal import (
+        Journal,
+    )
+
+    path = os.environ.get("TADNN_BENCH_JOURNAL") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_JOURNAL.jsonl"
+    )
+    try:
+        return Journal(path, host0_only=False, meta={"tool": "bench"})
+    except OSError:  # read-only checkout — incidents still hit stderr
+        return Journal(None, host0_only=False)
+
+
 def _canonical_argv(mode: str) -> bool:
     """True when argv is the mode's headline invocation — nothing but
     ``mode=`` plus the mode's allowlisted extras.  Guards BOTH sides of
@@ -1239,6 +1257,13 @@ _CANONICAL_EXTRA = {"decode": ("model=moe",)}
 def main():
     args = parse_args()
     err = _probe_backend()
+    with _bench_journal() as jnl:
+        _main_probed(args, err, jnl)
+
+
+def _main_probed(args, err, jnl):
+    jnl.event("bench.probe", mode=args["mode"], ok=err is None,
+              probe_error=err, argv=sys.argv[1:])
     cpu_ok = dict(MODE_SIM_DEVICES)
     cpu_ok["memfit"] = int(args.get("devices", cpu_ok["memfit"]))
     if err is not None:
@@ -1275,10 +1300,15 @@ def main():
             })
             rec["extra"] = extra
             rec["stale"] = True
+            jnl.event("bench.stale", mode=args["mode"], stale=True,
+                      probe_error=err, measured_utc=last["measured_utc"],
+                      metric=rec.get("metric"))
             log(f"emitting last committed TPU result "
                 f"(measured {last['measured_utc']})")
             print(json.dumps(rec), flush=True)
             return
+        jnl.event("bench.unmeasurable", mode=args["mode"], ok=False,
+                  probe_error=err)
         print(json.dumps({
             "metric": f"{args['mode']}_unmeasurable_backend_down",
             "value": 0.0,

@@ -45,6 +45,10 @@ def main():
     p.add_argument("--data-dir", default="",
                    help="dir with MNIST idx files or x_train/y_train.npy; "
                         "falls back to synthetic when empty/absent")
+    p.add_argument("--out-dir", default="",
+                   help="write run artifacts (journal.jsonl + "
+                        "metrics.jsonl) here and train via Trainer; "
+                        "inspect afterwards with `tadnn report <dir>`")
     args = p.parse_args()
 
     print(f"devices: {jax.device_count()} x {jax.devices()[0].device_kind}")
@@ -58,6 +62,8 @@ def main():
         loss_fn=softmax_xent_loss,
         strategy=args.strategy,
     )
+    if args.out_dir:
+        return run_observed(args, data, ad)
     state = ad.init(jax.random.key(0), data.batch(0))
     print(f"plan: strategy={ad.plan.strategy} "
           f"mesh={tad.mesh_degrees(ad.plan.mesh)}")
@@ -74,6 +80,38 @@ def main():
     imgs = args.steps * args.batch_size
     print(f"{imgs / dt:.0f} images/sec total "
           f"({imgs / dt / jax.device_count():.0f} /chip incl. compile)")
+
+
+def run_observed(args, data, ad):
+    """--out-dir path: same training via Trainer, leaving journal.jsonl +
+    metrics.jsonl behind for `tadnn report`."""
+    from torch_automatic_distributed_neural_network_tpu.obs import Journal
+    from torch_automatic_distributed_neural_network_tpu.training import (
+        MetricsLogger,
+        Trainer,
+        TrainerConfig,
+    )
+
+    os.makedirs(args.out_dir, exist_ok=True)
+    journal = Journal(os.path.join(args.out_dir, "journal.jsonl"))
+    metrics = MetricsLogger(os.path.join(args.out_dir, "metrics.jsonl"),
+                            items_name="images")
+    trainer = Trainer(
+        ad,
+        TrainerConfig(steps=args.steps, log_every=args.log_every),
+        metrics=metrics,
+        items_per_step=args.batch_size,
+        journal=journal,
+    )
+    trainer.fit(data)
+    journal.close()
+    gp = trainer.goodput or {}
+    if gp.get("fractions"):
+        print("goodput: " + "  ".join(
+            f"{k} {v:.1%}" for k, v in gp["fractions"].items()))
+    print(f"artifacts in {args.out_dir} — summarize with: "
+          f"python -m torch_automatic_distributed_neural_network_tpu "
+          f"report {args.out_dir}")
 
 
 if __name__ == "__main__":

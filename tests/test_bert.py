@@ -19,11 +19,17 @@ from torch_automatic_distributed_neural_network_tpu.models import (
     bert_config,
 )
 from torch_automatic_distributed_neural_network_tpu.training import (
+
     masked_lm_loss,
 )
 
 VOCAB = 256
 
+
+# Minutes-scale on the 8-device CPU sim (every case is a fresh
+# multi-device XLA compile): excluded from the quick tier-1 pass,
+# run with -m slow (or no marker filter) for full coverage.
+pytestmark = pytest.mark.slow
 
 def tiny(**kw):
     return Bert("test", vocab_size=VOCAB, max_seq_len=64,

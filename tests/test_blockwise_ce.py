@@ -21,11 +21,17 @@ from torch_automatic_distributed_neural_network_tpu.models.transformer_core impo
     TransformerConfig,
 )
 from torch_automatic_distributed_neural_network_tpu.training import (  # noqa: E402
+
     blockwise_next_token_loss,
     moe_next_token_loss,
     next_token_loss,
 )
 
+
+# Minutes-scale on the 8-device CPU sim (every case is a fresh
+# multi-device XLA compile): excluded from the quick tier-1 pass,
+# run with -m slow (or no marker filter) for full coverage.
+pytestmark = pytest.mark.slow
 
 def _apply_fn(model):
     return lambda p, *a, **k: model.apply({"params": p}, *a, **k)

@@ -70,3 +70,38 @@ def test_run_strips_separator(tmp_path, capsys):
     script.write_text("import sys\nprint('argv:', sys.argv[1:])\n")
     assert cli.main(["run", str(script), "--", "--steps", "5"]) == 0
     assert "argv: ['--steps', '5']" in capsys.readouterr().out
+
+
+def test_report_smoke(tmp_path, capsys):
+    """`tadnn report` summarizes a run dir from its JSONL artifacts —
+    pure file parsing, so the smoke needs no training run."""
+    from torch_automatic_distributed_neural_network_tpu.obs import Journal
+
+    j = Journal(str(tmp_path / "journal.jsonl"))
+    j.event("plan", strategy="dp", mesh={"data": 8})
+    j.event("compile", fn="train_step", dur_s=0.5, signature="[16,8]:f32")
+    j.event("goodput", total_wall_s=2.0,
+            seconds={"compile": 0.5, "step": 1.4, "checkpoint": 0.0,
+                     "eval": 0.0, "input_stall": 0.0, "idle": 0.1},
+            fractions={"compile": 0.25, "step": 0.7, "checkpoint": 0.0,
+                       "eval": 0.0, "input_stall": 0.0, "idle": 0.05},
+            goodput=0.7)
+    j.event("comms.estimate", strategy="dp", total_wire_bytes=7000,
+            per_device={"grad_allreduce": 4000}, model_dependent=[])
+    j.close()
+    (tmp_path / "metrics.jsonl").write_text(json.dumps(
+        {"step": 4, "step_time_s": 0.35, "loss": 1.25,
+         "items_per_sec_per_chip": 57.0}) + "\n")
+
+    assert cli.main(["report", str(tmp_path)]) == 0
+    text = capsys.readouterr().out
+    assert "compiles: 1" in text and "recompiles: 0" in text
+    assert "goodput: 70.0% of 2.0s wall" in text
+    assert "grad_allreduce 3.9 KiB" in text
+    assert "final loss 1.2500" in text
+
+    assert cli.main(["report", str(tmp_path), "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["compile"]["count"] == 1
+    assert rep["comms"]["total_wire_bytes"] == 7000
+    assert rep["training"]["last_step"] == 4

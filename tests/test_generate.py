@@ -16,6 +16,12 @@ from torch_automatic_distributed_neural_network_tpu.inference import (
 from torch_automatic_distributed_neural_network_tpu.models import GPT2, Llama
 
 
+
+# Minutes-scale on the 8-device CPU sim (every case is a fresh
+# multi-device XLA compile): excluded from the quick tier-1 pass,
+# run with -m slow (or no marker filter) for full coverage.
+pytestmark = pytest.mark.slow
+
 def _model_and_tokens(family, seed=0, b=2, p=12):
     make = GPT2 if family == "gpt2" else Llama
     model = make("test", vocab_size=128, max_seq_len=64,

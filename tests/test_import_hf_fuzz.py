@@ -10,6 +10,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 import torch
+pytest.importorskip("hypothesis")  # container image ships without it
 from hypothesis import given, settings, strategies as st
 
 transformers = pytest.importorskip("transformers")

@@ -21,6 +21,7 @@ from torch_automatic_distributed_neural_network_tpu.models import (
     TransformerMT,
 )
 from torch_automatic_distributed_neural_network_tpu.training import (
+
     next_token_loss,
     seq2seq_loss,
     softmax_xent_loss_mutable,
@@ -28,6 +29,11 @@ from torch_automatic_distributed_neural_network_tpu.training import (
 
 STEPS = 3
 
+
+# Minutes-scale on the 8-device CPU sim (every case is a fresh
+# multi-device XLA compile): excluded from the quick tier-1 pass,
+# run with -m slow (or no marker filter) for full coverage.
+pytestmark = pytest.mark.slow
 
 def run(model, loss_fn, data, strategy, devices=None, **kw):
     ad = tad.AutoDistribute(

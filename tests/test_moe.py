@@ -33,9 +33,15 @@ from torch_automatic_distributed_neural_network_tpu.planner import (
     _flatten_with_paths,
 )
 from torch_automatic_distributed_neural_network_tpu.training import (
+
     moe_next_token_loss,
 )
 
+
+# Minutes-scale on the 8-device CPU sim (every case is a fresh
+# multi-device XLA compile): excluded from the quick tier-1 pass,
+# run with -m slow (or no marker filter) for full coverage.
+pytestmark = pytest.mark.slow
 
 def _logits(b=2, s=32, e=4, seed=0):
     rng = np.random.RandomState(seed)

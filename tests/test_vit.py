@@ -13,9 +13,15 @@ from torch_automatic_distributed_neural_network_tpu.data.synthetic import (
 )
 from torch_automatic_distributed_neural_network_tpu.models import ViT
 from torch_automatic_distributed_neural_network_tpu.training import (
+
     softmax_xent_loss,
 )
 
+
+# Minutes-scale on the 8-device CPU sim (every case is a fresh
+# multi-device XLA compile): excluded from the quick tier-1 pass,
+# run with -m slow (or no marker filter) for full coverage.
+pytestmark = pytest.mark.slow
 
 def tiny():
     return ViT("test", image_size=32, patch_size=8, num_classes=10,

@@ -13,6 +13,7 @@ Short alias::
     # or:  import tadnn
 """
 
+from . import obs
 from .core import AutoDistribute, TrainState, autodistribute
 from .planner import (
     Rule,
@@ -51,5 +52,6 @@ __all__ = [
     "initialize_distributed",
     "mesh_degrees",
     "single_device_mesh",
+    "obs",
     "__version__",
 ]

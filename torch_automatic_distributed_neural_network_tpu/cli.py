@@ -206,6 +206,20 @@ def _mesh_degrees_or_none(ad):
             if ad.plan is not None else None)
 
 
+def cmd_report(args: argparse.Namespace) -> int:
+    """Summarize a finished (or crashed) run from its on-disk artifacts:
+    journal JSONL + MetricsLogger JSONL.  Pure file parsing — no jax
+    import, so it works on a machine with no accelerator runtime."""
+    from .obs import report as obs_report
+
+    rep = obs_report.generate(args.target, args.metrics)
+    if args.json:
+        print(json.dumps(rep))
+    else:
+        print(obs_report.format_report(rep))
+    return 0
+
+
 def cmd_tokenize(args: argparse.Namespace) -> int:
     """Text -> TADN token file (data/text.py)."""
     from .data.text import load_tokenizer, tokenize_file
@@ -271,6 +285,20 @@ def main(argv: list[str] | None = None) -> int:
                         "materializes [B,S,V] logits; big-vocab models "
                         "fit far smaller)")
     p.set_defaults(fn=cmd_fit)
+
+    p = sub.add_parser(
+        "report",
+        help="summarize a run's journal + metrics JSONL: compiles/"
+             "recompiles, goodput breakdown, expected comm bytes, "
+             "incidents (works offline; no accelerator needed)",
+    )
+    p.add_argument("target",
+                   help="run directory (searched for journal.jsonl / "
+                        "metrics.jsonl) or a journal file path")
+    p.add_argument("--metrics", default=None,
+                   help="explicit MetricsLogger JSONL path")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_report)
 
     p = sub.add_parser(
         "tokenize",

@@ -41,8 +41,29 @@ import numpy as np
 
 from . import planner as planner_mod
 from . import topology as topo_mod
+from .obs import journal as obs_journal
 from .training import precision as precision_mod
 from .training.optim import opt_state_spec_tree
+
+
+def _abstract_signature(tree: Any) -> tuple:
+    """Hashable (treedef, shapes, dtypes) key for a batch pytree — the
+    same abstraction jit caches on, so a NEW key on a warmed-up function
+    means XLA just retraced and recompiled (shape churn)."""
+    leaves, treedef = jax.tree.flatten(tree)
+    sig = []
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            arr = np.asarray(leaf)
+            shape, dtype = arr.shape, arr.dtype
+        sig.append((tuple(shape), str(dtype)))
+    return (treedef, tuple(sig))
+
+
+def _signature_str(key: tuple) -> str:
+    return ",".join(f"{list(s)}:{d}" for s, d in key[1])
 
 
 @struct.dataclass
@@ -206,6 +227,14 @@ class AutoDistribute:
         self._state_shardings = None
         self._apply_fn = model.apply if model is not None else None
         self._has_model_state = False
+        # recompile accounting (obs): abstract input signatures seen per
+        # jitted entrypoint; the first is THE compile, later new ones are
+        # shape-churn recompiles — a logged, testable signal.
+        self._fn_sigs: dict[str, set] = {}
+        self.compile_events: list[dict] = []
+        self.recompile_count = 0
+        self.comm_profile: dict | None = None  # planner comm estimate
+        self.last_compile_error: str | None = None  # AOT lower/compile
 
     # -- planning -----------------------------------------------------------
 
@@ -287,7 +316,31 @@ class AutoDistribute:
                 virtual=self._pipeline_virtual,
             )
             self.plan.remat = False
+        self._journal_plan(abstract)
         return self.plan
+
+    def _journal_plan(self, abstract_params: Any) -> None:
+        """Journal the chosen plan + its expected collective traffic."""
+        plan = self.plan
+        assert plan is not None
+        obs_journal.event(
+            "plan",
+            strategy=plan.strategy,
+            mesh=dict(topo_mod.mesh_degrees(plan.mesh)),
+            remat=plan.remat,
+            precision=str(np.dtype(self.precision.param_dtype)),
+            grad_accum=self._grad_accum,
+        )
+        try:
+            from .obs import comms as obs_comms
+
+            self.comm_profile = obs_comms.emit_estimate(
+                plan, abstract_params,
+                grad_dtype=self.precision.compute_dtype,
+                grad_accum=self._grad_accum,
+            )
+        except Exception as e:  # accounting must never break planning
+            self.comm_profile = {"error": f"{type(e).__name__}: {e}"}
 
     # Escalation ladders for strategy='search': cheapest collectives
     # first, sharded + remat last.  (strategy, outer_remat) pairs.
@@ -380,14 +433,17 @@ class AutoDistribute:
                     )
                     continue
                 if report is None:
-                    # compiled_cost swallows lowering/compile exceptions
-                    # into None: a PER-CANDIDATE failure (e.g. a sharding
-                    # error only visible at lowering) — record, escalate
+                    # a PER-CANDIDATE lower/compile failure (e.g. a
+                    # sharding error only visible at lowering) — record
+                    # the reason compiled_cost captured, escalate
                     self.search_report.append(
                         {"strategy": strat, "remat": remat,
                          "peak_bytes": None, "budget_bytes": int(budget),
                          "fits": False, "flops": None,
-                         "error": "lower/compile failed (see logs)"}
+                         "error": ("lower/compile failed: "
+                                   f"{self.last_compile_error}"
+                                   if self.last_compile_error
+                                   else "lower/compile failed (see logs)")}
                     )
                     continue
                 if not report.get("per_device_peak_bytes"):
@@ -551,8 +607,12 @@ class AutoDistribute:
         from .utils.profiling import compiled_cost
 
         cost = compiled_cost(self._step_fn, state_abs, batch_abs)
-        if cost is None:
+        if cost is None or cost.get("error"):
+            # keep the reason: "cost analysis unavailable" and "compile
+            # failed: <why>" are different diagnoses (obs satellite)
+            self.last_compile_error = (cost or {}).get("error")
             return None
+        self.last_compile_error = None
         mem = cost.get("memory") or {}
         peak = None
         if mem:
@@ -757,6 +817,47 @@ class AutoDistribute:
             out_shardings=(shardings, None),
             donate_argnums=(0,) if self._donate else (),
         )
+        # a fresh jitted step starts a fresh jit cache — recompile
+        # accounting must not carry signatures across it
+        self._fn_sigs.pop("train_step", None)
+
+    # -- recompile accounting ------------------------------------------------
+
+    @property
+    def n_compiles(self) -> int:
+        """Total trace+compile events observed (first compiles + recompiles)."""
+        return len(self.compile_events)
+
+    def _timed_dispatch(self, fn_name: str, fn, state, batch):
+        """Dispatch through a jitted fn, detecting jit cache misses.
+
+        The key is the batch's abstract signature (shapes+dtypes+treedef
+        — what jit caches on; the state's signature is fixed after
+        ``_compile_step``).  A fresh key means this call traced and
+        compiled synchronously before dispatching, so wrapping it in a
+        host timer measures the compile; steady-state keys skip straight
+        to the (async) dispatch with one set-lookup of overhead.
+        """
+        seen = self._fn_sigs.setdefault(fn_name, set())
+        key = _abstract_signature(batch)
+        if key in seen:
+            return fn(state, batch)
+        import time
+
+        t0 = time.perf_counter()
+        out = fn(state, batch)
+        dt = time.perf_counter() - t0
+        seen.add(key)
+        first = len(seen) == 1
+        name = "compile" if first else "recompile"
+        if not first:
+            self.recompile_count += 1
+        rec = {"event": name, "fn": fn_name, "dur_s": dt,
+               "signature": _signature_str(key)}
+        self.compile_events.append(rec)
+        obs_journal.event(name, fn=fn_name, dur_s=dt,
+                          signature=rec["signature"])
+        return out
 
     def step(self, state: TrainState, batch) -> tuple[TrainState, dict]:
         """One optimizer step.  Hot loop: dispatch-only after first compile.
@@ -768,7 +869,7 @@ class AutoDistribute:
         assert self._step_fn is not None, "call init() first"
         if jax.process_count() > 1:
             batch = self.shard_batch(batch)
-        return self._step_fn(state, batch)
+        return self._timed_dispatch("train_step", self._step_fn, state, batch)
 
     def eval_step(self, state: TrainState, batch) -> dict:
         """Forward-only loss/metrics, deterministic: the training loss_fn
@@ -804,9 +905,10 @@ class AutoDistribute:
                     self._state_shardings, self.plan.batch_sharding()
                 ),
             )
+            self._fn_sigs.pop("eval_step", None)
         if jax.process_count() > 1:
             batch = self.shard_batch(batch)
-        return self._eval_fn(state, batch)
+        return self._timed_dispatch("eval_step", self._eval_fn, state, batch)
 
     # -- inference ----------------------------------------------------------
 

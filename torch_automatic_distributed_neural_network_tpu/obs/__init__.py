@@ -1,0 +1,29 @@
+"""Run-wide observability layer (SURVEY.md §5; TorchTitan-style, see
+PAPERS.md): span/event journal, recompile + comms accounting, goodput
+breakdown, and the ``tadnn report`` backend.
+
+The layer is pull-free and zero-dep: library code emits spans/events to
+a process-global journal (``set_default`` / ``TADNN_JOURNAL`` env); when
+none is installed every call is a cheap no-op.
+"""
+
+from .goodput import BUCKETS, GoodputMeter
+from .journal import (
+    Journal,
+    as_default,
+    event,
+    get_default,
+    set_default,
+    span,
+)
+
+__all__ = [
+    "BUCKETS",
+    "GoodputMeter",
+    "Journal",
+    "as_default",
+    "event",
+    "get_default",
+    "set_default",
+    "span",
+]

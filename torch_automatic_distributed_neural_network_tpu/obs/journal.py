@@ -1,0 +1,209 @@
+"""Span/event journal — the run-wide observability spine (SURVEY.md §5).
+
+Every phase of a run (compile, step, checkpoint, eval, elastic events,
+bench probe status) lands here as one JSON line with BOTH clocks:
+
+- ``t``: seconds on the process monotonic clock relative to journal
+  creation — durations and ordering survive wall-clock jumps;
+- ``wall``: unix time — joinable against MetricsLogger records and logs.
+
+Zero-dep (json/time/os only; jax is touched lazily and optionally, for
+host-0 gating).  Usable three ways::
+
+    j = Journal("run/journal.jsonl")
+    j.event("elastic.resize", hosts=4)           # point event
+    with j.span("compile", fn="train_step"):     # timed span
+        ...
+    obs.set_default(j)                           # process-global sink:
+    obs.event("watchdog.stall", age_s=12.0)      # library code logs here
+
+With no default installed, module-level ``span``/``event`` are cheap
+no-ops (a null journal), so instrumented library code costs nothing in
+un-observed runs.  ``TADNN_JOURNAL=<path>`` in the environment installs
+a default sink automatically on first use.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from typing import Any, IO, Iterator
+
+
+def _process_index() -> int:
+    """Host index, without forcing jax (or its backend) to load."""
+    try:
+        import sys
+
+        jax = sys.modules.get("jax")
+        if jax is None:
+            return 0
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+class Journal:
+    """Monotonic-timestamped JSONL event/span sink.
+
+    ``path=None`` keeps records in memory only (``self.records``) — the
+    test/tooling mode.  ``host0_only=True`` (default) makes non-zero
+    hosts' journals silent no-ops so multi-host runs produce one file.
+    """
+
+    def __init__(self, path: str | None = None, *,
+                 host0_only: bool = True, meta: dict | None = None):
+        self.path = path
+        self.enabled = (not host0_only) or _process_index() == 0
+        self._t0 = time.monotonic()
+        self._depth = 0
+        self._file: IO | None = None
+        self.records: list[dict] = []  # in-memory sink when path is None
+        self.counts: dict[str, int] = {}
+        if self.enabled and path:
+            d = os.path.dirname(os.path.abspath(path))
+            os.makedirs(d, exist_ok=True)
+            self._file = open(path, "a")
+        if self.enabled:
+            self.event("journal.start", **(meta or {}))
+
+    # -- sinks --------------------------------------------------------------
+
+    def _write(self, rec: dict) -> None:
+        if not self.enabled:
+            return
+        self.counts[rec.get("name", "?")] = (
+            self.counts.get(rec.get("name", "?"), 0) + 1
+        )
+        if self._file is not None:
+            self._file.write(json.dumps(rec, default=str) + "\n")
+            self._file.flush()
+        else:
+            self.records.append(rec)
+
+    def event(self, name: str, **fields: Any) -> dict | None:
+        """One point-in-time record: ``{"kind": "event", "name": ...}``."""
+        if not self.enabled:
+            return None
+        rec = {"kind": "event", "name": name,
+               "t": time.monotonic() - self._t0, "wall": time.time(),
+               "depth": self._depth, **fields}
+        self._write(rec)
+        return rec
+
+    @contextlib.contextmanager
+    def span(self, name: str, **fields: Any) -> Iterator[dict]:
+        """Timed region.  Yields the record-in-progress so callers can
+        attach result fields before it is written on exit; exceptions are
+        recorded (``error`` field) and re-raised."""
+        rec: dict[str, Any] = {"kind": "span", "name": name, **fields}
+        if not self.enabled:
+            yield rec
+            return
+        t_start = time.monotonic()
+        rec["t"] = t_start - self._t0
+        rec["wall"] = time.time()
+        rec["depth"] = self._depth
+        self._depth += 1
+        try:
+            yield rec
+        except BaseException as e:
+            rec["error"] = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            self._depth -= 1
+            rec["dur_s"] = time.monotonic() - t_start
+            self._write(rec)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+    def __enter__(self) -> "Journal":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- reading ------------------------------------------------------------
+
+    @staticmethod
+    def read(path: str) -> list[dict]:
+        """Parse a journal file, skipping torn/partial lines."""
+        out: list[dict] = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
+        return out
+
+
+class _NullJournal(Journal):
+    """Sink of last resort: every call is a no-op."""
+
+    def __init__(self):  # noqa: D401 — deliberately skips Journal.__init__
+        self.path = None
+        self.enabled = False
+        self._file = None
+        self.records = []
+        self.counts = {}
+        self._depth = 0
+        self._t0 = time.monotonic()
+
+
+_NULL = _NullJournal()
+_default: Journal | None = None
+
+
+def set_default(journal: Journal | None) -> Journal | None:
+    """Install (or clear, with None) the process-global journal."""
+    global _default
+    _default = journal
+    return journal
+
+
+def get_default() -> Journal:
+    """The process-global journal; honors ``TADNN_JOURNAL`` env on first
+    call; a silent null sink when nothing is configured."""
+    global _default
+    if _default is None:
+        env = os.environ.get("TADNN_JOURNAL")
+        if env:
+            _default = Journal(env)
+    return _default if _default is not None else _NULL
+
+
+@contextlib.contextmanager
+def as_default(journal: Journal | None) -> Iterator[Journal]:
+    """Temporarily install ``journal`` as the process default (restores
+    the previous default on exit).  ``None`` is a pass-through."""
+    global _default
+    if journal is None:
+        yield get_default()
+        return
+    prev = _default
+    _default = journal
+    try:
+        yield journal
+    finally:
+        _default = prev
+
+
+def event(name: str, **fields: Any) -> dict | None:
+    """Module-level event on the default journal (no-op when unset)."""
+    return get_default().event(name, **fields)
+
+
+def span(name: str, **fields: Any):
+    """Module-level span on the default journal (no-op when unset)."""
+    return get_default().span(name, **fields)

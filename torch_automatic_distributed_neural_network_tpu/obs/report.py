@@ -1,0 +1,241 @@
+"""`tadnn report`: join the event journal with MetricsLogger JSONL and
+answer "where did the wall-clock go?" from artifacts the run produced.
+
+Inputs: a run directory (containing ``journal.jsonl`` and optionally
+``metrics.jsonl``) or explicit file paths.  Output: one dict (``--json``)
+or a human summary — throughput, MFU, compile/recompile accounting,
+expected comm bytes vs. XLA bytes-accessed, goodput breakdown, and any
+bench probe/tunnel incidents recorded in the journal.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Any
+
+from .journal import Journal
+
+JOURNAL_NAMES = ("journal.jsonl", "events.jsonl")
+METRICS_NAMES = ("metrics.jsonl",)
+
+
+def _find(directory: str, names: tuple[str, ...], suffix: str) -> str | None:
+    for n in names:
+        p = os.path.join(directory, n)
+        if os.path.isfile(p):
+            return p
+    hits = sorted(
+        f for f in os.listdir(directory) if f.endswith(suffix)
+    )
+    return os.path.join(directory, hits[0]) if hits else None
+
+
+def resolve_paths(target: str,
+                  metrics: str | None = None) -> tuple[str, str | None]:
+    """(journal_path, metrics_path) from a dir / journal file + override."""
+    if os.path.isdir(target):
+        jp = _find(target, JOURNAL_NAMES, ".journal.jsonl")
+        if jp is None:
+            raise FileNotFoundError(
+                f"no journal (journal.jsonl / *.journal.jsonl) in {target}"
+            )
+        mp = metrics or _find(target, METRICS_NAMES, ".metrics.jsonl")
+        return jp, mp
+    return target, metrics
+
+
+def _read_metrics(path: str) -> list[dict]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
+    return out
+
+
+def _finite(vals) -> list[float]:
+    return [v for v in vals
+            if isinstance(v, (int, float)) and math.isfinite(v)]
+
+
+def _mean(vals) -> float | None:
+    vals = _finite(vals)
+    return sum(vals) / len(vals) if vals else None
+
+
+def generate(target: str, metrics_path: str | None = None) -> dict:
+    """Build the run-summary dict from on-disk artifacts."""
+    journal_path, metrics_path = resolve_paths(target, metrics_path)
+    events = Journal.read(journal_path)
+    report: dict[str, Any] = {
+        "journal": journal_path,
+        "metrics": metrics_path,
+        "n_journal_records": len(events),
+    }
+    if events:
+        ts = _finite([e.get("t") for e in events])
+        report["journal_wall_s"] = (max(ts) - min(ts)) if ts else 0.0
+
+    def last(name):
+        for e in reversed(events):
+            if e.get("name") == name:
+                return e
+        return None
+
+    plan = last("plan")
+    if plan:
+        report["plan"] = {k: plan.get(k)
+                          for k in ("strategy", "mesh", "remat", "precision")
+                          if plan.get(k) is not None}
+    compiles = [e for e in events if e.get("name") == "compile"]
+    recompiles = [e for e in events if e.get("name") == "recompile"]
+    report["compile"] = {
+        "count": len(compiles),
+        "total_s": sum(_finite(e.get("dur_s") for e in compiles)),
+        "recompile_count": len(recompiles),
+        "recompile_total_s": sum(_finite(e.get("dur_s") for e in recompiles)),
+        "recompile_reasons": [
+            {k: e.get(k) for k in ("fn", "signature", "dur_s")}
+            for e in recompiles
+        ],
+    }
+    good = last("goodput")
+    if good:
+        report["goodput"] = {k: good.get(k)
+                             for k in ("total_wall_s", "seconds",
+                                       "fractions", "goodput")}
+    comms = last("comms.estimate")
+    if comms:
+        report["comms"] = {k: comms.get(k)
+                           for k in ("strategy", "total_wire_bytes",
+                                     "per_device", "model_dependent")}
+    cross = last("comms.crosscheck")
+    if cross:
+        report["comms_crosscheck"] = {
+            k: cross.get(k)
+            for k in ("expected_wire_bytes", "xla_bytes_accessed",
+                      "comm_fraction_of_bytes_accessed", "consistent")}
+    probes = [e for e in events
+              if str(e.get("name", "")).startswith("bench.")]
+    if probes:
+        report["bench_incidents"] = [
+            {k: v for k, v in e.items() if k not in ("kind", "depth")}
+            for e in probes
+            if e.get("name") in ("bench.probe", "bench.stale",
+                                 "bench.unmeasurable")
+            and (e.get("probe_error") or e.get("stale")
+                 or e.get("ok") is False)
+        ]
+    stalls = [e for e in events if e.get("name") == "watchdog.stall"]
+    restarts = [e for e in events if e.get("name") == "elastic.restart"]
+    if stalls or restarts:
+        report["incidents"] = {
+            "watchdog_stalls": len(stalls),
+            "elastic_restarts": len(restarts),
+        }
+    if metrics_path and os.path.isfile(metrics_path):
+        recs = _read_metrics(metrics_path)
+        steps = [r for r in recs if "step_time_s" in r]
+        per_chip = [v for r in steps for k, v in r.items()
+                    if k.endswith("_per_sec_per_chip") and v]
+        report["training"] = {
+            "n_step_records": len(steps),
+            "last_step": max((r.get("step", 0) for r in steps), default=None),
+            "mean_step_time_s": _mean(r.get("step_time_s") for r in steps),
+            "items_per_sec_per_chip": _mean(per_chip),
+            "mean_mfu": _mean(r.get("mfu") for r in steps
+                              if "mfu" in r),
+            "final_loss": next(
+                (r["loss"] for r in reversed(steps) if "loss" in r), None),
+        }
+    return report
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "n/a"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return f"{n:.1f} TiB"
+
+
+def format_report(report: dict) -> str:
+    """Human-readable rendering of :func:`generate`'s dict."""
+    lines = [f"run journal: {report['journal']} "
+             f"({report['n_journal_records']} records, "
+             f"{report.get('journal_wall_s', 0.0):.1f}s span)"]
+    plan = report.get("plan")
+    if plan:
+        lines.append(f"plan: strategy={plan.get('strategy')} "
+                     f"mesh={plan.get('mesh')}")
+    c = report["compile"]
+    lines.append(
+        f"compiles: {c['count']} ({c['total_s']:.2f}s)   "
+        f"recompiles: {c['recompile_count']} "
+        f"({c['recompile_total_s']:.2f}s)"
+        + ("  <- shape churn, check input pipeline"
+           if c["recompile_count"] else "")
+    )
+    tr = report.get("training")
+    if tr:
+        parts = [f"steps logged: {tr['n_step_records']}"]
+        if tr.get("mean_step_time_s") is not None:
+            parts.append(f"mean step {tr['mean_step_time_s'] * 1e3:.1f}ms")
+        if tr.get("items_per_sec_per_chip"):
+            parts.append(f"{tr['items_per_sec_per_chip']:,.0f} items/s/chip")
+        if tr.get("mean_mfu") is not None:
+            parts.append(f"MFU {tr['mean_mfu']:.1%}")
+        if tr.get("final_loss") is not None:
+            parts.append(f"final loss {tr['final_loss']:.4f}")
+        lines.append("training: " + "  ".join(parts))
+    good = report.get("goodput")
+    if good and good.get("fractions"):
+        fr = good["fractions"]
+        lines.append(
+            "goodput: {:.1%} of {:.1f}s wall".format(
+                good.get("goodput", 0.0), good.get("total_wall_s", 0.0))
+        )
+        lines.append("  " + "  ".join(
+            f"{b} {fr[b]:.1%}" for b in
+            ("compile", "step", "checkpoint", "eval", "input_stall", "idle")
+            if b in fr))
+    comms = report.get("comms")
+    if comms:
+        per = comms.get("per_device") or {}
+        lines.append(
+            f"comms (per device/step, {comms.get('strategy')}): "
+            f"wire { _fmt_bytes(comms.get('total_wire_bytes')) }   "
+            + "  ".join(f"{k} {_fmt_bytes(v)}" for k, v in per.items() if v)
+        )
+        md = comms.get("model_dependent")
+        if md:
+            lines.append(f"  model-dependent (unquantified): {', '.join(md)}")
+    cross = report.get("comms_crosscheck")
+    if cross and cross.get("xla_bytes_accessed"):
+        lines.append(
+            f"  XLA bytes-accessed {_fmt_bytes(cross['xla_bytes_accessed'])}"
+            f" -> comm fraction "
+            f"{cross.get('comm_fraction_of_bytes_accessed') or 0:.1%}"
+            + ("" if cross.get("consistent") else
+               "  !! estimate exceeds measurement")
+        )
+    inc = report.get("incidents")
+    if inc:
+        lines.append(f"incidents: {inc['watchdog_stalls']} watchdog stalls, "
+                     f"{inc['elastic_restarts']} elastic restarts")
+    bi = report.get("bench_incidents")
+    if bi:
+        lines.append(f"bench incidents: {len(bi)}")
+        for e in bi[-3:]:
+            lines.append(f"  {e.get('name')}: mode={e.get('mode')} "
+                         f"error={e.get('probe_error')} "
+                         f"stale={e.get('stale')}")
+    return "\n".join(lines)

@@ -261,7 +261,7 @@ def attention(
             # custom call is not partitionable — run it under shard_map
             # over the batch (and head, under TP) axes, which is exact:
             # attention is independent per batch element and per head.
-            from jax import shard_map
+            from ..utils.jax_compat import shard_map
             from jax.sharding import PartitionSpec as P
 
             tp = ctx.degrees.get(ctx.head_axis, 1)

@@ -38,6 +38,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .attention import _check_window
 
+# jax 0.5 renamed pltpu.TPUCompilerParams -> CompilerParams; accept both
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)
+
 _NEG_BIG = -0.7 * float(np.finfo(np.float32).max)
 _LANES = 128  # TPU lane width: scratch row-stats are stored broadcast
 
@@ -175,7 +180,7 @@ def _fwd(q, k, v, cfg: _Cfg):
             pltpu.VMEM((cfg.block_q, _LANES), jnp.float32),
             pltpu.VMEM((cfg.block_q, _LANES), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=cfg.interpret,
@@ -324,7 +329,7 @@ def _bwd_impl(cfg: _Cfg, res, do, dlse):
             pltpu.VMEM((cfg.block_k, d), jnp.float32),
             pltpu.VMEM((cfg.block_k, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=cfg.interpret,
@@ -337,7 +342,7 @@ def _bwd_impl(cfg: _Cfg, res, do, dlse):
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((cfg.block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=cfg.interpret,

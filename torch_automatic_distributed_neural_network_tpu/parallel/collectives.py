@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from ..utils.jax_compat import axis_size, shard_map
 
 
 # ---------------------------------------------------------------------------
@@ -76,7 +76,7 @@ def all_to_all(
 
 def ppermute_ring(x: jax.Array, axis: str, shift: int = 1) -> jax.Array:
     """Rotate shards around the ring (ring attention / pipeline hop)."""
-    n = jax.lax.axis_size(axis)
+    n = axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return jax.lax.ppermute(x, axis, perm)
 

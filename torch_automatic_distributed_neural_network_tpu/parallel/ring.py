@@ -35,7 +35,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from ..utils.jax_compat import axis_size, shard_map
 
 from ..ops.flash_attention import flash_attention_with_lse
 
@@ -71,7 +71,7 @@ def ring_attention(
     traffic scales with Hkv, not H); the flash kernel broadcasts heads
     per block.
     """
-    cp = jax.lax.axis_size(axis_name)
+    cp = axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     b, sl, hq, dh = q.shape
 
@@ -118,7 +118,7 @@ def ring_attention(
 
 
 def _rotate(kv, axis_name):
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
     return jax.tree.map(lambda x: jax.lax.ppermute(x, axis_name, perm), kv)
 

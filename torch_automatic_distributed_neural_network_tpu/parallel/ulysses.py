@@ -18,7 +18,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from ..utils.jax_compat import axis_size, shard_map
 
 from ..ops.attention import xla_attention
 
@@ -43,7 +43,7 @@ def ulysses_attention(
     GQA note: k/v heads must also divide the cp degree; callers with
     fewer kv heads broadcast them first (ops.attention does this).
     """
-    cp = jax.lax.axis_size(axis_name)
+    cp = axis_size(axis_name)
     hq = q.shape[2]
     if hq % cp:
         raise ValueError(f"Ulysses needs heads ({hq}) divisible by cp ({cp})")

@@ -19,12 +19,65 @@ from typing import TYPE_CHECKING
 import jax
 import orbax.checkpoint as ocp
 
+from ..obs import journal as obs_journal
+
 if TYPE_CHECKING:  # runtime import would be circular (core -> training)
     from ..core import AutoDistribute, TrainState
 
 
+def _is_key(x: Any) -> bool:
+    import jax.numpy as jnp
+
+    try:
+        return jnp.issubdtype(x.dtype, jax.dtypes.prng_key)
+    except (AttributeError, TypeError):
+        return False
+
+
+def _encode_keys(tree: Any) -> Any:
+    """Typed PRNG keys -> raw uint32 key data (orbax in this environment
+    cannot serialize the opaque key dtype; the raw counter words are the
+    portable representation)."""
+    return jax.tree.map(
+        lambda x: jax.random.key_data(x) if _is_key(x) else x, tree
+    )
+
+
+def _encode_abstract_keys(tree: Any) -> Any:
+    """The abstract-tree mirror of :func:`_encode_keys`: key-dtype
+    ShapeDtypeStructs become uint32 structs of the key-data shape, other
+    leaves (and their target shardings) pass through."""
+
+    def enc(x):
+        if not _is_key(x):
+            return x
+        data = jax.eval_shape(
+            jax.random.key_data, jax.ShapeDtypeStruct(x.shape, x.dtype)
+        )
+        sharding = getattr(x, "sharding", None)
+        if sharding is not None:
+            return jax.ShapeDtypeStruct(data.shape, data.dtype,
+                                        sharding=sharding)
+        return jax.ShapeDtypeStruct(data.shape, data.dtype)
+
+    return jax.tree.map(enc, tree)
+
+
+def _decode_keys(tree: Any, like: Any) -> Any:
+    """Re-wrap raw key data as typed keys wherever ``like`` had one."""
+    return jax.tree.map(
+        lambda x, ref: jax.random.wrap_key_data(x) if _is_key(ref) else x,
+        tree, like,
+    )
+
+
 class CheckpointManager:
-    """Thin wrapper over an Orbax CheckpointManager for TrainStates."""
+    """Thin wrapper over an Orbax CheckpointManager for TrainStates.
+
+    Typed PRNG-key leaves (``jax.random.key``) are transparently stored
+    as their raw uint32 key data and re-wrapped on restore — the key
+    dtype itself is not serializable by every orbax version.
+    """
 
     def __init__(
         self,
@@ -48,11 +101,15 @@ class CheckpointManager:
     def save(self, step: int, state: "TrainState", config: dict | None = None,
              force: bool = False) -> bool:
         args = {
-            "state": ocp.args.StandardSave(state),
+            "state": ocp.args.StandardSave(_encode_keys(state)),
             "config": ocp.args.JsonSave(config if config is not None else {}),
         }
-        return self._mngr.save(step, args=ocp.args.Composite(**args),
-                               force=force)
+        # span covers only save *dispatch* — async commit lands in wait()
+        with obs_journal.span("ckpt.save", step=step) as rec:
+            saved = self._mngr.save(step, args=ocp.args.Composite(**args),
+                                    force=force)
+            rec["saved"] = bool(saved)
+        return saved
 
     def latest_step(self) -> int | None:
         return self._mngr.latest_step()
@@ -68,13 +125,16 @@ class CheckpointManager:
         step = self._mngr.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"No checkpoint found in {self.directory}")
-        out = self._mngr.restore(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(abstract_state)
-            ),
-        )
-        return out["state"]
+        with obs_journal.span("ckpt.restore", step=step):
+            out = self._mngr.restore(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(
+                        _encode_abstract_keys(abstract_state)
+                    )
+                ),
+            )
+        return _decode_keys(out["state"], abstract_state)
 
     def restore_config(self, step: int | None = None) -> dict | None:
         step = self._mngr.latest_step() if step is None else step
@@ -89,7 +149,8 @@ class CheckpointManager:
             return None
 
     def wait(self) -> None:
-        self._mngr.wait_until_finished()
+        with obs_journal.span("ckpt.wait"):
+            self._mngr.wait_until_finished()
 
     def close(self) -> None:
         self._mngr.wait_until_finished()

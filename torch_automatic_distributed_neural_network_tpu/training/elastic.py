@@ -38,6 +38,8 @@ from typing import Any, Callable
 
 import jax
 
+from ..obs import journal as obs_journal
+
 
 class InjectedFault(RuntimeError):
     """Raised by FaultInjector; distinguishable from real failures."""
@@ -178,6 +180,8 @@ class StepWatchdog:
         self._thread: threading.Thread | None = None
 
     def _default_stall(self, age_s: float) -> None:
+        obs_journal.event("watchdog.stall", age_s=age_s,
+                          timeout_s=self.timeout_s)
         print(
             f"[tadnn watchdog] no step completed for {age_s:.1f}s "
             f"(timeout {self.timeout_s}s) — training appears stalled",
@@ -251,6 +255,7 @@ class PreemptionGuard:
 
     def _on_signal(self, signum, frame) -> None:
         self._requested.set()
+        obs_journal.event("preempt.signal", signum=int(signum))
         print(
             f"[tadnn] received signal {signum}: draining — will "
             f"checkpoint and exit after the current step",
@@ -312,6 +317,12 @@ def run_with_recovery(
             return fit()
         except retriable as e:
             attempt += 1
+            obs_journal.event(
+                "elastic.restart", attempt=attempt,
+                max_restarts=max_restarts,
+                error=f"{type(e).__name__}: {e}",
+                gave_up=attempt > max_restarts,
+            )
             if attempt > max_restarts:
                 raise
             if on_restart is not None:

@@ -12,6 +12,7 @@ import dataclasses
 import json
 import sys
 import time
+import warnings
 from typing import Any, IO
 
 import jax
@@ -77,6 +78,7 @@ class MetricsLogger:
         self._t_last: float | None = None
         self._peak = peak_flops_per_chip()
         self._n_chips = jax.device_count()
+        self._dropped_warned: set[str] = set()
 
     def start_step(self) -> None:
         self._t_last = time.perf_counter()
@@ -105,7 +107,7 @@ class MetricsLogger:
             try:
                 record[k] = float(v)
             except (TypeError, ValueError):
-                pass
+                self._warn_dropped(k, v)
         parts = [f"step {step:5d}"]
         if "loss" in record:
             parts.append(f"loss {record['loss']:.4f}")
@@ -136,7 +138,7 @@ class MetricsLogger:
             try:
                 record[k] = float(v)
             except (TypeError, ValueError):
-                pass
+                self._warn_dropped(k, v)
         parts = [f"step {step:5d}"] + [
             f"{k} {v:.4f}" for k, v in record.items()
             if k not in ("step", "time")
@@ -144,6 +146,30 @@ class MetricsLogger:
         self._emit(record, parts, console=self.console)
         return record
 
+    def _warn_dropped(self, key: str, value: Any) -> None:
+        """Warn ONCE per metric key that is silently unloggable — a step
+        fn returning arrays/strings otherwise loses those series with no
+        trace, and the gap is only noticed at analysis time."""
+        if key in self._dropped_warned:
+            return
+        self._dropped_warned.add(key)
+        warnings.warn(
+            f"MetricsLogger: dropping non-scalar metric {key!r} "
+            f"(type {type(value).__name__}) — log_step/log_eval record "
+            "only float()-able scalars; reduce it in the step fn "
+            "(warned once per key)",
+            stacklevel=3,
+        )
+
     def close(self) -> None:
+        """Close the JSONL file (idempotent; later log calls fall back to
+        console-only instead of crashing on a closed handle)."""
         if self._file:
             self._file.close()
+            self._file = None
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import time
 from typing import Any, Callable, Iterable
 
 import jax
@@ -27,11 +28,14 @@ import numpy as np
 
 from typing import TYPE_CHECKING
 
+from ..obs import GoodputMeter
+from ..obs import journal as obs_journal
 from .checkpoint import CheckpointManager, restore_or_init
 from .metrics import MetricsLogger
 
 if TYPE_CHECKING:  # runtime import would be circular (core -> training)
     from ..core import AutoDistribute, TrainState
+    from ..obs import Journal
 
 
 @dataclasses.dataclass
@@ -77,6 +81,7 @@ class Trainer:
         run_config: dict | None = None,
         callbacks: "list[Callable[[int, TrainState, dict], None]] | None" = None,
         eval_data: Any = None,
+        journal: "Journal | None" = None,
     ):
         self.ad = ad
         self.cfg = cfg
@@ -86,6 +91,8 @@ class Trainer:
         self.run_config = run_config
         self.callbacks = list(callbacks or [])
         self.eval_data = eval_data
+        self.journal = journal  # installed as the default sink during fit()
+        self.goodput: dict | None = None  # last fit()'s wall-clock breakdown
         self.preempt = None  # PreemptionGuard, installed during fit()
 
     def evaluate(
@@ -138,20 +145,56 @@ class Trainer:
         exactly the batches an uninterrupted run would have seen at each
         step (elastic parity, SURVEY.md §5); a plain iterator restarts
         from its beginning on resume.
+
+        Observability: ``self.journal`` (when given) is installed as the
+        process-global journal for the duration, so AutoDistribute
+        compile/recompile events, checkpoint spans and elastic events all
+        land in one file; wall-clock is bucketed into a goodput breakdown
+        (``self.goodput``, also journaled as a ``goodput`` event).
         """
+        with obs_journal.as_default(self.journal):
+            try:
+                return self._fit(data, rng=rng, state=state)
+            finally:
+                if self.metrics:
+                    # run teardown owns the JSONL handle (metrics.close
+                    # is idempotent; a later fit() just loses file
+                    # logging, never crashes)
+                    self.metrics.close()
+
+    def _fit(
+        self,
+        data: "Iterable[Any] | Any",
+        *,
+        rng: jax.Array | None = None,
+        state: "TrainState | None" = None,
+    ) -> "TrainState":
         cfg = self.cfg
+        meter = GoodputMeter()
         indexed = _is_step_indexed(data)
         data_iter = None if indexed else iter(data)
         first = None
+        resumed = False
         if state is None:
-            first = data.batch(0) if indexed else next(data_iter)
+            with meter.measure("input_stall"):
+                first = data.batch(0) if indexed else next(data_iter)
             rng = rng if rng is not None else jax.random.key(0)
-            state, resumed = restore_or_init(self.ad, self.ckpt, rng, first)
+            # init = trace + compile + (maybe) checkpoint restore; the
+            # restore I/O is tiny next to the jit work, so one bucket
+            with meter.measure("compile"):
+                state, resumed = restore_or_init(
+                    self.ad, self.ckpt, rng, first
+                )
             start = int(state.step)
             if resumed and jax.process_index() == 0:
                 print(f"resumed from step {start}")
         else:
             start = int(state.step)
+        obs_journal.event(
+            "run_start", start_step=start, steps=cfg.steps, resumed=resumed,
+            strategy=(self.ad.plan.strategy if self.ad.plan else None),
+        )
+        last_done = start
 
         from .elastic import Heartbeat, PreemptionGuard, StepWatchdog
 
@@ -175,9 +218,20 @@ class Trainer:
                     batch = data.batch(start)
             pending_metrics = None
             for i in range(start, cfg.steps):
+                t0 = time.perf_counter()
+                n_before = self.ad.n_compiles + self.ad.recompile_count
                 state, step_metrics = self.ad.step(state, batch)
+                dur = time.perf_counter() - t0
+                # a dispatch that tripped a (re)trace blocked on XLA, so
+                # its wall time is compile, not useful step time
+                tripped = (self.ad.n_compiles + self.ad.recompile_count
+                           > n_before)
+                meter.add("compile" if tripped else "step", dur)
+                last_done = i + 1
                 if i + 1 < cfg.steps:
-                    batch = data.batch(i + 1) if indexed else next(data_iter)
+                    with meter.measure("input_stall"):
+                        batch = (data.batch(i + 1) if indexed
+                                 else next(data_iter))
                 if cfg.watchdog_timeout_s:
                     # Beat on step *completion*, not dispatch — a hung
                     # collective must stop the beats (elastic.py).  Block
@@ -185,7 +239,8 @@ class Trainer:
                     # dispatched, so waiting for i-1 keeps one step of
                     # host/device overlap instead of serializing dispatch.
                     if pending_metrics is not None:
-                        jax.block_until_ready(pending_metrics)
+                        with meter.measure("step"):
+                            jax.block_until_ready(pending_metrics)
                         if watchdog is None:
                             watchdog = StepWatchdog(
                                 cfg.watchdog_timeout_s
@@ -209,9 +264,10 @@ class Trainer:
                     cfg.eval_every and self.eval_data is not None
                     and (i + 1) % cfg.eval_every == 0
                 ):
-                    ev = self.evaluate(
-                        self.eval_data, cfg.eval_batches, state=state
-                    )
+                    with meter.measure("eval"):
+                        ev = self.evaluate(
+                            self.eval_data, cfg.eval_batches, state=state
+                        )
                     slow_block = True
                     if self.metrics:
                         self.metrics.log_eval(i + 1, ev)
@@ -222,7 +278,8 @@ class Trainer:
                     self.ckpt and cfg.ckpt_every
                     and (i + 1) % cfg.ckpt_every == 0
                 ):
-                    self.ckpt.save(i + 1, state, config=self.run_config)
+                    with meter.measure("checkpoint"):
+                        self.ckpt.save(i + 1, state, config=self.run_config)
                     slow_block = True
                 for cb in self.callbacks:
                     cb(i + 1, state, step_metrics)
@@ -230,14 +287,17 @@ class Trainer:
                     # graceful drain: save where we are and return; the
                     # recovery path (restore_or_init / run_with_recovery)
                     # resumes from exactly this step on the next start
+                    obs_journal.event("preempt.drain", step=i + 1,
+                                      saved=bool(self.ckpt))
                     if self.ckpt:
                         # the periodic block above may have saved this
                         # very step; orbax refuses to overwrite it
-                        if self.ckpt.latest_step() != i + 1:
-                            self.ckpt.save(i + 1, state,
-                                           config=self.run_config,
-                                           force=True)
-                        self.ckpt.wait()
+                        with meter.measure("checkpoint"):
+                            if self.ckpt.latest_step() != i + 1:
+                                self.ckpt.save(i + 1, state,
+                                               config=self.run_config,
+                                               force=True)
+                            self.ckpt.wait()
                     if jax.process_index() == 0:
                         print(f"preemption drain: stopped after step "
                               f"{i + 1}"
@@ -252,15 +312,17 @@ class Trainer:
                 # flush the lag-one beat: the final step (the only step,
                 # when resuming one short of cfg.steps) must arm/beat the
                 # watchdog so a hang in the closing save/wait is detected
-                jax.block_until_ready(pending_metrics)
+                with meter.measure("step"):
+                    jax.block_until_ready(pending_metrics)
                 if watchdog is None:
                     watchdog = StepWatchdog(cfg.watchdog_timeout_s).start()
                 watchdog.beat()
             if self.ckpt and cfg.ckpt_every:
-                if self.ckpt.latest_step() != cfg.steps:
-                    self.ckpt.save(cfg.steps, state, config=self.run_config,
-                                   force=True)
-                self.ckpt.wait()
+                with meter.measure("checkpoint"):
+                    if self.ckpt.latest_step() != cfg.steps:
+                        self.ckpt.save(cfg.steps, state,
+                                       config=self.run_config, force=True)
+                    self.ckpt.wait()
         finally:
             if watchdog:
                 watchdog.stop()
@@ -271,7 +333,16 @@ class Trainer:
             if self.ckpt:
                 # barrier for in-flight async saves: a recovery restart
                 # must not race the pending commit (elastic.py)
-                self.ckpt.wait()
+                with meter.measure("checkpoint"):
+                    self.ckpt.wait()
+            summary = meter.summary()
+            self.goodput = summary
+            obs_journal.event("goodput", **summary)
+            obs_journal.event(
+                "run_end", stop_step=last_done,
+                n_compiles=self.ad.n_compiles,
+                recompiles=self.ad.recompile_count,
+            )
         return state
 
     def _drain_agreed(self, step: int) -> bool:

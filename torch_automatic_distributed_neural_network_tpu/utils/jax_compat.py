@@ -1,0 +1,41 @@
+"""Version-tolerant imports for jax APIs that moved between releases.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to the
+top-level ``jax`` namespace, and its replication-check keyword was
+renamed ``check_rep`` -> ``check_vma`` in the same move.  Every in-repo
+call site imports from here so either jax generation works unchanged.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # jax >= 0.6: public API, check_vma keyword
+    from jax import shard_map as _raw_shard_map  # type: ignore[attr-defined]
+except ImportError:  # older jax: experimental module, check_rep keyword
+    from jax.experimental.shard_map import shard_map as _raw_shard_map
+
+_HAS_VMA = "check_vma" in inspect.signature(_raw_shard_map).parameters
+
+
+def shard_map(f, *args, **kwargs):
+    """``jax.shard_map`` with the replication-check kwarg translated to
+    whatever name the installed jax understands."""
+    if _HAS_VMA and "check_rep" in kwargs:
+        kwargs["check_vma"] = kwargs.pop("check_rep")
+    elif not _HAS_VMA and "check_vma" in kwargs:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _raw_shard_map(f, *args, **kwargs)
+
+
+def axis_size(axis_name):
+    """Static size of a named mesh axis from inside ``shard_map``/``pmap``.
+
+    ``jax.lax.axis_size`` only exists in newer jax; older releases expose
+    the bound frame size via ``jax.core.axis_frame`` (a plain int).
+    """
+    import jax
+
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.core.axis_frame(axis_name)

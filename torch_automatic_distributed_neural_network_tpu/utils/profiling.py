@@ -60,19 +60,48 @@ def _memory_of(compiled) -> dict | None:
         return None
 
 
+def _bytes_accessed_of(compiled) -> float | None:
+    """Total HBM bytes the executable touches per invocation (XLA cost
+    analysis) — the measured upper bound for the planner's analytic
+    comm-bytes estimate (obs.comms.crosscheck)."""
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, list):
+            cost = cost[0]
+        return float(cost.get("bytes accessed", 0.0)) or None
+    except Exception:
+        return None
+
+
 def compiled_cost(fn, *args, **kwargs) -> dict | None:
-    """ONE AOT compile, both analyses: ``{'flops': ..., 'memory': ...}``.
+    """ONE AOT compile, all analyses: ``{'flops': ..., 'memory': ...,
+    'bytes_accessed': ...}``.
 
     Prefer this over calling :func:`compiled_flops` and
     :func:`compiled_memory` separately — each does its own
     lower().compile(), minutes of redundant XLA work on big sharded
-    steps.  None when the backend can't lower/compile at all.
+    steps.
+
+    Lower/compile failures return ``{'flops': None, 'memory': None,
+    'error': '<reason>'}`` (and emit a ``cost_analysis.error`` journal
+    event), so "compile failed: <why>" is distinguishable from "compiled
+    fine but the backend exposes no analysis" (which returns analysis
+    fields of None with NO 'error' key).
     """
+    from ..obs import journal as _journal
+
     try:
-        compiled = fn.lower(*args, **kwargs).compile()
-    except Exception:
-        return None
-    return {"flops": _flops_of(compiled), "memory": _memory_of(compiled)}
+        with _journal.span("compile", fn="aot_cost_analysis"):
+            compiled = fn.lower(*args, **kwargs).compile()
+    except Exception as e:
+        reason = f"{type(e).__name__}: {e}"
+        _journal.event("cost_analysis.error", error=reason)
+        return {"flops": None, "memory": None, "error": reason}
+    out = {"flops": _flops_of(compiled), "memory": _memory_of(compiled)}
+    ba = _bytes_accessed_of(compiled)
+    if ba is not None:
+        out["bytes_accessed"] = ba
+    return out
 
 
 def compiled_flops(fn, *args, **kwargs) -> float | None:
@@ -82,7 +111,7 @@ def compiled_flops(fn, *args, **kwargs) -> float | None:
     experimental platforms); callers fall back to analytic 6ND estimates.
     """
     cost = compiled_cost(fn, *args, **kwargs)
-    return cost["flops"] if cost else None
+    return cost["flops"] if cost and not cost.get("error") else None
 
 
 def compiled_memory(fn, *args, **kwargs) -> dict | None:
@@ -91,7 +120,7 @@ def compiled_memory(fn, *args, **kwargs) -> dict | None:
     the planner's analytic HBM model against on real hardware.  None when
     the backend doesn't expose it."""
     cost = compiled_cost(fn, *args, **kwargs)
-    return cost["memory"] if cost else None
+    return cost["memory"] if cost and not cost.get("error") else None
 
 
 def memory_stats(device: Any | None = None) -> dict | None:
