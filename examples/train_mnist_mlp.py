@@ -40,7 +40,7 @@ def main():
     p.add_argument("--batch-size", type=int, default=128)
     p.add_argument("--lr", type=float, default=0.05)
     p.add_argument("--strategy", default="auto",
-                   choices=["auto", "dp", "fsdp", "tp", "tp_fsdp"])
+                   choices=["auto", "tuned", "dp", "fsdp", "tp", "tp_fsdp"])
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--data-dir", default="",
                    help="dir with MNIST idx files or x_train/y_train.npy; "

@@ -132,7 +132,7 @@ def test_sliding_window_gqa_and_chunked():
     assert jnp.max(jnp.abs(ref - chk)) < 2e-5
 
 
-def test_sliding_window_validation():
+def test_sliding_window_validation(devices8):
     q, k, v = _qkv(1, 32, 2, 16)
     with pytest.raises(ValueError, match="causal"):
         flash_attention(q, k, v, causal=False, window=8)
@@ -140,11 +140,22 @@ def test_sliding_window_validation():
         xla_attention(q, k, v, causal=False, window=8)
     with pytest.raises(ValueError, match="window"):
         flash_attention(q, k, v, causal=True, window=0)
-    with pytest.raises(NotImplementedError, match="context parallelism"):
-        from torch_automatic_distributed_neural_network_tpu.ops.attention import (  # noqa: E501
-            attention as attn_dispatch,
-        )
-        attn_dispatch(q, k, v, causal=True, window=8, impl="ring")
+    # without a sharded seq axis the ring/ulysses impls are degenerate —
+    # a windowed model on a single chip must fall back to xla attention,
+    # not trip the cp-only NotImplementedError
+    out = attention(q, k, v, causal=True, window=8, impl="ring")
+    ref = xla_attention(q, k, v, causal=True, window=8)
+    assert jnp.max(jnp.abs(ref - out)) == 0
+    # with a REAL seq axis the unsupported combination still errors loudly
+    import torch_automatic_distributed_neural_network_tpu as tad
+    from torch_automatic_distributed_neural_network_tpu.parallel import (
+        context as pctx,
+    )
+
+    mesh = tad.build_mesh(data=4, seq=2)
+    with pctx.use(pctx.ParallelContext(mesh=mesh)):
+        with pytest.raises(NotImplementedError, match="context parallelism"):
+            attention(q, k, v, causal=True, window=8, impl="ring")
 
 
 def test_window_validation_shared_across_paths():

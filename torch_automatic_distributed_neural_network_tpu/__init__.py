@@ -14,6 +14,7 @@ Short alias::
 """
 
 from . import obs
+from . import tune
 from .core import AutoDistribute, TrainState, autodistribute
 from .planner import (
     Rule,
@@ -53,5 +54,6 @@ __all__ = [
     "mesh_degrees",
     "single_device_mesh",
     "obs",
+    "tune",
     "__version__",
 ]

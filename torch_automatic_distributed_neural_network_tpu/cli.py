@@ -104,16 +104,11 @@ def cmd_bench(args: argparse.Namespace) -> int:
     return 0
 
 
-def cmd_fit(args: argparse.Namespace) -> int:
-    """Will this model fit? Abstract-shapes AOT compile + XLA memory
-    analysis (AutoDistribute.compile_report) — nothing materialized, so
-    it answers for models far larger than this host.  One JSON line per
-    measured candidate."""
-    import jax
+def _family_setup(args: argparse.Namespace):
+    """(model, loss_fn, sample_batch) for the model-zoo CLI commands
+    (fit, tune) from --family/--size/--seq/--batch."""
     import numpy as np
-    import optax
 
-    from . import AutoDistribute
     from .models import GPT2, Bert, Llama, MoE, ViT
     from .training import (
         blockwise_next_token_loss,
@@ -127,13 +122,7 @@ def cmd_fit(args: argparse.Namespace) -> int:
               "bert": Bert, "vit": ViT}[args.family]
     size = args.size or {"gpt2": "1p3b", "llama": "8b", "moe": "test",
                          "bert": "large", "vit": "large"}[args.family]
-    if args.loss == "blockwise" and args.family in ("bert", "vit"):
-        # blockwise CE is a CAUSAL next-token loss; silently running it
-        # on an encoder would fit-report a graph no real config trains
-        print(json.dumps({"error": "--loss blockwise is next-token "
-                          "(causal); bert uses masked LM, vit uses "
-                          "classification"}))
-        return 1
+    blockwise = getattr(args, "loss", "full") == "blockwise"
     if args.family == "vit":
         side = args.seq or 224  # --seq is the image side for ViT
         model = family(size, image_size=side)
@@ -150,7 +139,7 @@ def cmd_fit(args: argparse.Namespace) -> int:
                 "labels": np.full((args.batch, seq), -100, np.int32),
             }
         else:
-            if args.loss == "blockwise":
+            if blockwise:
                 loss = blockwise_next_token_loss()
             else:
                 loss = (moe_next_token_loss if args.family == "moe"
@@ -158,6 +147,28 @@ def cmd_fit(args: argparse.Namespace) -> int:
             sample = {
                 "tokens": np.zeros((args.batch, seq + 1), np.int32),
             }
+    return model, loss, sample
+
+
+def cmd_fit(args: argparse.Namespace) -> int:
+    """Will this model fit? Abstract-shapes AOT compile + XLA memory
+    analysis (AutoDistribute.compile_report) — nothing materialized, so
+    it answers for models far larger than this host.  One JSON line per
+    measured candidate."""
+    import jax
+
+    import optax
+
+    from . import AutoDistribute
+
+    if args.loss == "blockwise" and args.family in ("bert", "vit"):
+        # blockwise CE is a CAUSAL next-token loss; silently running it
+        # on an encoder would fit-report a graph no real config trains
+        print(json.dumps({"error": "--loss blockwise is next-token "
+                          "(causal); bert uses masked LM, vit uses "
+                          "classification"}))
+        return 1
+    model, loss, sample = _family_setup(args)
     ad = AutoDistribute(
         model,
         optimizer=optax.adamw(1e-4),
@@ -196,6 +207,98 @@ def cmd_fit(args: argparse.Namespace) -> int:
     chosen = ad.plan.strategy if ad.plan is not None else None
     print(json.dumps({"chosen_strategy": chosen,
                       "mesh": _mesh_degrees_or_none(ad)}))
+    return 0
+
+
+def cmd_tune(args: argparse.Namespace) -> int:
+    """Rank candidate parallelism plans with the tune/ cost model (and
+    optionally measure the top-k with a real microbenchmark), printing
+    the per-candidate cost breakdown the decision was made from."""
+    import jax
+    import optax
+
+    from . import AutoDistribute, topology, tune
+
+    model, loss, sample = _family_setup(args)
+    ad = AutoDistribute(model, optimizer=optax.adamw(1e-4), loss_fn=loss,
+                        precision=args.precision)
+    rng = jax.random.key(0)
+    abstract_vars = jax.eval_shape(ad._init_variables, rng, sample)
+    abstract, _ = ad._split_variables(abstract_vars)
+
+    topo = topology.detect()
+    policy = tune.TunePolicy(
+        grad_accums=tuple(int(g) for g in args.grad_accums.split(",")),
+        top_k=args.top_k,
+        batch_items=tune.estimate_batch_items(sample),
+        use_cache=not args.no_cache,
+    )
+    result = tune.tune(abstract, topo, policy=policy)
+    ranked = result.ranked
+    if not ranked:  # cache hit or fallback — re-rank locally for display
+        kept, _ = tune.enumerate_candidates(
+            abstract, topo, grad_accums=policy.grad_accums,
+            max_tensor=policy.max_tensor, state_factor=policy.state_factor,
+            batch_items=policy.batch_items, safety=policy.safety,
+        )
+        ranked = tune.rank(abstract, topo, kept,
+                           state_factor=policy.state_factor,
+                           batch_items=policy.batch_items,
+                           safety=policy.safety) if kept else []
+
+    measured: dict[str, float] = {}
+    if args.measure and ranked:
+        def make_ad(cand):
+            return AutoDistribute(
+                model, optimizer=optax.adamw(1e-4), loss_fn=loss,
+                strategy=cand.strategy,
+                mesh=topology.build_mesh(**cand.degrees_dict),
+                grad_accum=cand.grad_accum, precision=args.precision,
+            )
+
+        trials = tune.measure.measure_candidates(
+            [e.candidate for e in ranked[:args.top_k]], make_ad, rng, sample,
+        )
+        measured = {t["candidate"]: t.get("step_time_ms")
+                    for t in trials if t.get("step_time_ms")}
+
+    if args.json:
+        for i, est in enumerate(ranked):
+            row = {"rank": i, **est.to_json()}
+            if est.candidate.label() in measured:
+                row["measured_ms"] = measured[est.candidate.label()]
+            print(json.dumps(row))
+        print(json.dumps({
+            "chosen_strategy": result.strategy, "mesh": result.degrees,
+            "grad_accum": result.grad_accum, "source": result.source,
+            "cache_key": result.key,
+        }))
+        return 0
+
+    print(f"devices: {topo.num_devices} x {topo.device_kind}  "
+          f"candidates: {len(ranked)}  source: {result.source}")
+    hdr = (f"{'rank':>4} {'strategy':<9} {'mesh':<24} {'ga':>2} "
+           f"{'step_ms':>9} {'compute':>8} {'comm':>8} {'hbm':>8} "
+           f"{'mem_gib':>8} fit")
+    if measured:
+        hdr += f" {'measured':>9}"
+    print(hdr)
+    for i, est in enumerate(ranked):
+        b = est.breakdown
+        mesh = "x".join(f"{a}{n}" for a, n in est.candidate.degrees if n > 1)
+        line = (f"{i:>4} {est.candidate.strategy:<9} {mesh or '1':<24} "
+                f"{est.candidate.grad_accum:>2} "
+                f"{est.step_time_s * 1e3:>9.3f} {b['compute_ms']:>8.3f} "
+                f"{b['comm_ms']:>8.3f} {b['hbm_ms']:>8.3f} "
+                f"{b['memory']['total_bytes'] / 2**30:>8.2f} "
+                f"{'y' if est.fits else 'N'}")
+        m = measured.get(est.candidate.label())
+        if measured:
+            line += f" {m:>9.3f}" if m is not None else f" {'-':>9}"
+        print(line)
+    print(f"chosen: {result.strategy} {result.degrees} "
+          f"grad_accum={result.grad_accum} ({result.source}; "
+          f"cache {tune.cache.cache_path()})")
     return 0
 
 
@@ -285,6 +388,35 @@ def main(argv: list[str] | None = None) -> int:
                         "materializes [B,S,V] logits; big-vocab models "
                         "fit far smaller)")
     p.set_defaults(fn=cmd_fit)
+
+    p = sub.add_parser(
+        "tune",
+        help="rank candidate parallelism plans for a model-zoo config "
+             "with the analytic cost model (tune/); --measure also "
+             "compiles and times the top-k on the real train step",
+    )
+    p.add_argument("--family", default="gpt2",
+                   choices=("gpt2", "llama", "moe", "bert", "vit"))
+    p.add_argument("--size", default=None,
+                   help="model size preset; default per family "
+                        "(gpt2: 1p3b, llama: 8b, moe: test, bert: large, "
+                        "vit: large); for vit, --seq is the image side")
+    p.add_argument("--seq", type=int, default=None)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--precision", default="fp32")
+    p.add_argument("--top-k", type=int, default=3,
+                   help="candidates to measure with --measure")
+    p.add_argument("--grad-accums", default="1",
+                   help="comma-separated grad-accumulation choices to "
+                        "include in the search space")
+    p.add_argument("--measure", action="store_true",
+                   help="compile + time the top-k candidates (journaled "
+                        "as tune.trial spans)")
+    p.add_argument("--no-cache", action="store_true",
+                   help="skip the persistent tuning cache "
+                        "(~/.cache/tadnn/, TADNN_TUNE_CACHE)")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_tune)
 
     p = sub.add_parser(
         "report",

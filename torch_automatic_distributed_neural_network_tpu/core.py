@@ -100,15 +100,19 @@ class AutoDistribute:
     init_fn:
         ``(rng, batch) -> params`` — overrides ``model.init``.
     strategy:
-        'auto' | 'search' | 'dp' | 'fsdp' | 'tp' | 'tp_fsdp' | 'ep' |
-        'ep_fsdp' | 'ep_tp' (MoE: experts on the expert axis, each expert
-        Megatron-split on tensor).  'auto' picks from model size vs HBM
-        (planner.choose_strategy, analytic).  'search' walks an
-        escalation ladder and accepts the first candidate whose
-        XLA-measured per-device peak (compile_report: AOT compile from
-        abstract shapes, nothing materialized) fits the chip's HBM —
-        the measured version of 'auto'; per-candidate numbers land in
-        ``self.search_report``.
+        'auto' | 'tuned' | 'search' | 'dp' | 'fsdp' | 'tp' | 'tp_fsdp' |
+        'ep' | 'ep_fsdp' | 'ep_tp' (MoE: experts on the expert axis,
+        each expert Megatron-split on tensor).  'auto' picks from model
+        size vs HBM (planner.choose_strategy, analytic).  'tuned' ranks
+        every candidate mesh factorization with the tune/ cost model
+        (collective bytes over ICI/DCN link speeds + HBM pressure),
+        caches the decision under ~/.cache/tadnn/, and journals why it
+        won — falls back to the 'auto' heuristic when the space is
+        degenerate.  'search' walks an escalation ladder and accepts
+        the first candidate whose XLA-measured per-device peak
+        (compile_report: AOT compile from abstract shapes, nothing
+        materialized) fits the chip's HBM — the measured version of
+        'auto'; per-candidate numbers land in ``self.search_report``.
     mesh:
         Explicit ``jax.sharding.Mesh``; built from strategy if omitted.
     remat:
@@ -268,6 +272,21 @@ class AutoDistribute:
         abstract, abstract_ms = self._split_variables(abstract_vars)
         self._has_model_state = bool(jax.tree.leaves(abstract_ms))
         prec = self.precision
+        state_factor = (
+            prec.bytes_per_param / np.dtype(prec.param_dtype).itemsize
+        )
+        tune_policy = None
+        if self._strategy == "tuned":
+            # the tuner sees the real batch (tokens/items per step) and
+            # the configured accumulation, so its memory/cost estimates
+            # match what this AutoDistribute will actually run
+            from . import tune as tune_mod
+
+            tune_policy = tune_mod.TunePolicy(
+                batch_items=tune_mod.estimate_batch_items(sample_batch),
+                grad_accums=(self._grad_accum,),
+                state_factor=state_factor,
+            )
         self.plan = planner_mod.make_plan(
             abstract,
             mesh=self._mesh,
@@ -277,9 +296,8 @@ class AutoDistribute:
             remat=self._remat,
             seq=self._seq_parallel,
             pipe=self._pipeline_stages,
-            state_factor=(
-                prec.bytes_per_param / np.dtype(prec.param_dtype).itemsize
-            ),
+            state_factor=state_factor,
+            tune_policy=tune_policy,
         )
         from .parallel import context as pctx
 

@@ -9,12 +9,15 @@ choices agree is accepted — plus the target's next token as a bonus, so
 every round emits between 1 and k+1 tokens with exactly one target
 chunk.
 
-The greedy variant's contract is EXACT EQUALITY: the emitted sequence
-is bit-identical to what plain greedy decoding of the target alone
-would produce, for ANY draft model — a bad draft only costs speed
-(acceptance rate), never correctness.  tests/test_speculative.py pins
-this with both a self-draft (always accepts) and an unrelated
-random-init draft (rarely accepts).
+The greedy variant's contract: the emitted sequence matches plain
+greedy decoding of the target alone, for ANY draft model — a bad draft
+only costs speed (acceptance rate), never correctness.  "Matches" is
+exact up to floating-point chunk-width reassociation: verifying k+1
+positions in one chunk can reassociate reductions differently than
+k+1 single-token steps, so logits near an exact argmax tie may flip on
+low-precision accumulations.  tests/test_speculative.py pins equality
+at fp32 on the CPU sim with both a self-draft (always accepts) and an
+unrelated random-init draft (rarely accepts).
 
 Both models run through the same :func:`..inference.decode.
 forward_cached` as everything else (sliding windows, GQA, int8-

@@ -604,6 +604,36 @@ def _hf_norm_eps(model_or_state_dict, default=1e-12) -> float:
                  if hf_cfg is not None else default)
 
 
+# HF hidden_act -> our TransformerConfig.act: HF's 'gelu' is the exact
+# erf formulation (our 'gelu_exact'); 'gelu_new'/'gelu_pytorch_tanh'
+# are the tanh approximation (our 'gelu', nn.gelu's default).
+_HF_ACT_MAP = {
+    "gelu": "gelu_exact",
+    "gelu_new": "gelu",
+    "gelu_pytorch_tanh": "gelu",
+}
+
+
+def _hf_act(model_or_state_dict, default="gelu_exact") -> str:
+    """Map ``hf_cfg.hidden_act`` to our act name; raise on activations
+    we have no kernel for (silently importing everything as exact gelu
+    drifts logits on e.g. relu-activated variants)."""
+    hf_cfg = getattr(model_or_state_dict, "config", None)
+    if hf_cfg is None:
+        return default  # bare state_dict — keep the historical default
+    act = getattr(hf_cfg, "hidden_act", None)
+    if act is None:
+        return default
+    if not isinstance(act, str) or act not in _HF_ACT_MAP:
+        raise ValueError(
+            f"unsupported HF hidden_act {act!r}: this importer maps "
+            f"{sorted(_HF_ACT_MAP)} onto the framework's gelu variants; "
+            "other activations would silently change the imported "
+            "model's logits"
+        )
+    return _HF_ACT_MAP[act]
+
+
 def _hf_encoder_block(L, attn, n_heads, hd, d) -> dict:
     """The q/k/v/o + intermediate/output mapping every HF encoder
     layout shares; ``attn`` is the self-attention prefix
@@ -684,6 +714,7 @@ def import_hf_bert(
         type_vocab_size=tte.shape[0],
         # variants ship non-default eps; a silent mismatch drifts logits
         norm_eps=_hf_norm_eps(model_or_state_dict),
+        act=_hf_act(model_or_state_dict),
         **({"dtype": dtype} if dtype is not None else {}),
     )
     layers = []

@@ -93,6 +93,41 @@ def generate(target: str, metrics_path: str | None = None) -> dict:
         report["plan"] = {k: plan.get(k)
                           for k in ("strategy", "mesh", "remat", "precision")
                           if plan.get(k) is not None}
+    decision = last("tune.decision")
+    hit = last("tune.cache_hit")
+    fallback = last("tune.fallback")
+    chosen = decision or hit or fallback
+    if chosen:
+        tuning: dict[str, Any] = {
+            "source": ("cache" if chosen is hit else
+                       "fallback" if chosen is fallback else
+                       chosen.get("source", "cost_model")),
+            "strategy": chosen.get("strategy"),
+            "mesh": chosen.get("degrees") or chosen.get("mesh"),
+            "grad_accum": chosen.get("grad_accum"),
+            "step_time_ms": chosen.get("step_time_ms"),
+            "reason": chosen.get("reason"),
+            "n_candidates": chosen.get("n_candidates"),
+            "breakdown": chosen.get("breakdown"),
+        }
+        cands = [e for e in events if e.get("name") == "tune.candidate"]
+        if cands:
+            tuning["candidates"] = [
+                {k: e.get(k) for k in
+                 ("rank", "strategy", "mesh", "grad_accum",
+                  "step_time_ms", "fits")}
+                for e in cands
+            ]
+        trials = [e for e in events
+                  if e.get("name") == "tune.trial.result"]
+        if trials:
+            tuning["trials"] = [
+                {k: e.get(k) for k in
+                 ("candidate", "step_time_ms", "error") if e.get(k)}
+                for e in trials
+            ]
+        report["tuning"] = {k: v for k, v in tuning.items()
+                            if v is not None}
     compiles = [e for e in events if e.get("name") == "compile"]
     recompiles = [e for e in events if e.get("name") == "recompile"]
     report["compile"] = {
@@ -176,6 +211,33 @@ def format_report(report: dict) -> str:
     if plan:
         lines.append(f"plan: strategy={plan.get('strategy')} "
                      f"mesh={plan.get('mesh')}")
+    tun = report.get("tuning")
+    if tun:
+        head = (f"tuner: strategy={tun.get('strategy')} "
+                f"mesh={tun.get('mesh')} ({tun.get('source')}")
+        if tun.get("n_candidates"):
+            head += f", {tun['n_candidates']} candidates"
+        if tun.get("step_time_ms") is not None:
+            head += f", modeled {tun['step_time_ms']:.3f}ms/step"
+        lines.append(head + ")")
+        if tun.get("reason"):
+            lines.append(f"  {tun['reason']}")
+        b = tun.get("breakdown")
+        if b:
+            lines.append(
+                "  breakdown: " + "  ".join(
+                    f"{k.removesuffix('_ms')} {b[k]:.3f}ms"
+                    for k in ("compute_ms", "comm_ms", "hbm_ms",
+                              "latency_ms") if b.get(k) is not None))
+        trials = tun.get("trials")
+        if trials:
+            ok = [t for t in trials if t.get("step_time_ms") is not None]
+            msg = f"  measured trials: {len(trials)}"
+            if ok:
+                best = min(ok, key=lambda t: t["step_time_ms"])
+                msg += (f", best {best.get('candidate')} "
+                        f"{best['step_time_ms']:.3f}ms")
+            lines.append(msg)
     c = report["compile"]
     lines.append(
         f"compiles: {c['count']} ({c['total_s']:.2f}s)   "

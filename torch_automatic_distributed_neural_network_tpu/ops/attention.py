@@ -289,6 +289,13 @@ def attention(
             return fn(q, k, v)
         return flash_attention(q, k, v, causal=causal, window=window)
     if impl in ("ring", "ulysses"):
+        if ctx is None or cp <= 1:
+            # degenerate: no seq axis -> plain attention is identical,
+            # and the xla path handles window/mask natively — so a
+            # single-chip run of a windowed model must not hit the
+            # cp-only NotImplementedErrors below
+            return xla_attention(q, k, v, causal=causal, window=window,
+                                 mask=mask)
         if mask is not None:
             raise NotImplementedError(
                 f"{impl} attention does not take explicit masks (causal only)"
@@ -299,9 +306,6 @@ def attention(
                 "context parallelism (ring/ulysses) — train windowed "
                 "models with dp/fsdp/tp, or drop seq_parallel"
             )
-        if ctx is None or cp <= 1:
-            # degenerate: no seq axis -> plain attention is identical
-            return xla_attention(q, k, v, causal=causal)
         head_axis = (
             ctx.head_axis if ctx.degrees.get(ctx.head_axis, 1) > 1 else None
         )

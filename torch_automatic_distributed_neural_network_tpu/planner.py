@@ -21,6 +21,10 @@ Strategy catalogue (mirrors the reference's exercised configs):
 - ``tp_fsdp``   TP rules first, FSDP on what remains
 - ``auto``      pick one of the above from model size vs per-chip HBM and
                 mesh shape
+- ``tuned``     cost-model-driven search over candidate factorizations
+                (tune/ subsystem: enumerate -> score -> cache); falls
+                back to the ``auto`` heuristic when the space is
+                degenerate
 """
 
 from __future__ import annotations
@@ -399,6 +403,21 @@ def detect_expert_count(abstract_params: Any) -> int | None:
     return int(banks[0][1].shape[-3]) if banks else None
 
 
+def tp_applicable(abstract_params: Any, rules: Sequence[Rule]) -> bool:
+    """True if any rule would actually shard a dim of this model's params
+    on the 'tensor' axis (replication/bias rules don't count)."""
+    paths = [p for p, _ in _flatten_with_paths(
+        jax.tree.map(lambda x: P(), abstract_params))]
+    tp_rules = [
+        r for r in rules
+        if any(
+            ax == "tensor" or (isinstance(ax, tuple) and "tensor" in ax)
+            for ax in r.dim_axes
+        )
+    ]
+    return any(r.matches(p) for p in paths for r in tp_rules)
+
+
 def choose_strategy(
     abstract_params: Any,
     topo: topo_mod.Topology,
@@ -456,19 +475,7 @@ def choose_strategy(
             # can't keep both axes nontrivial -> fall through to fsdp/dp
     if train_state_bytes < 0.6 * _hbm_bytes(topo.device_kind):
         return "dp", {"data": n}
-    paths = [p for p, _ in _flatten_with_paths(
-        jax.tree.map(lambda x: P(), abstract_params))]
-    # A rule makes the model "TP-applicable" only if it actually shards a
-    # dim on the tensor axis (replication/bias rules don't count).
-    tp_rules = [
-        r for r in rules
-        if any(
-            ax == "tensor" or (isinstance(ax, tuple) and "tensor" in ax)
-            for ax in r.dim_axes
-        )
-    ]
-    tp_applicable = any(r.matches(p) for p in paths for r in tp_rules)
-    if tp_applicable:
+    if tp_applicable(abstract_params, rules):
         for t in (8, 4, 2):
             if n % t == 0 and t <= n:
                 return "tp_fsdp", {"fsdp": n // t, "tensor": t}
@@ -620,6 +627,7 @@ def make_plan(
     seq: int = 1,
     pipe: int = 1,
     state_factor: float = 4.0,
+    tune_policy: Any = None,
 ) -> ShardPlan:
     """The planner: abstract params + topology -> ShardPlan.
 
@@ -628,8 +636,16 @@ def make_plan(
     strategy is applied on it as-is; otherwise the mesh is built from the
     chosen/requested strategy.  ``pipe`` > 1 adds a pipeline axis; layer
     stacks shard their leading dim onto it (parallel/pipeline.py).
+
+    ``strategy='tuned'`` hands the choice to the tune/ subsystem
+    (enumerate candidate factorizations, rank by the analytic cost
+    model, cache the decision); ``tune_policy`` is an optional
+    ``tune.TunePolicy`` refining the search (batch size, grad-accum
+    choices, cache on/off).  Falls back to the ``auto`` heuristic when
+    the candidate space is degenerate (e.g. 1 device).
     """
-    known = ("auto", "dp", "fsdp", "tp", "tp_fsdp", "ep", "ep_fsdp", "ep_tp")
+    known = ("auto", "tuned", "dp", "fsdp", "tp", "tp_fsdp", "ep",
+             "ep_fsdp", "ep_tp")
     if strategy not in known:
         raise ValueError(f"Unknown strategy {strategy!r}; expected one of {known}")
     if pipe > 1 and strategy in ("ep", "ep_fsdp", "ep_tp"):
@@ -661,16 +677,27 @@ def make_plan(
                     f"{n} devices"
                 )
             n //= seq
-        if strategy == "auto":
-            resolved, degrees = choose_strategy(
-                abstract_params, dataclasses.replace(topo, num_devices=n),
-                rules, state_factor=state_factor,
-            )
+        if strategy in ("auto", "tuned"):
+            sub_topo = dataclasses.replace(topo, num_devices=n)
+            if strategy == "tuned":
+                from . import tune as tune_mod
+
+                result = tune_mod.tune(
+                    abstract_params, sub_topo, rules=rules,
+                    policy=tune_policy
+                    or tune_mod.TunePolicy(state_factor=state_factor),
+                )
+                resolved, degrees = result.strategy, dict(result.degrees)
+            else:
+                resolved, degrees = choose_strategy(
+                    abstract_params, sub_topo, rules,
+                    state_factor=state_factor,
+                )
             if pipe > 1 and resolved in ("ep", "ep_fsdp"):
                 import warnings
 
                 warnings.warn(
-                    f"auto strategy chose {resolved!r} but pipeline "
+                    f"{strategy} strategy chose {resolved!r} but pipeline "
                     f"parallelism does not compose with expert parallelism "
                     f"(README strategy-composition matrix); falling back "
                     f"to 'fsdp' — the expert banks shard on the fsdp axis "
@@ -752,7 +779,8 @@ def make_plan(
                 f"(its 'seq' axis is {topo_mod.mesh_degrees(mesh).get('seq', 1)}); "
                 "build the mesh with seq=<degree> or drop seq_parallel"
             )
-        if strategy == "auto":
+        if strategy in ("auto", "tuned"):
+            # an explicit mesh fixes every degree — nothing to tune
             d = topo_mod.mesh_degrees(mesh)
             if d.get("expert", 1) > 1:
                 if d.get("tensor", 1) > 1:

@@ -69,6 +69,58 @@ class Topology:
     def is_multislice(self) -> bool:
         return self.num_slices > 1
 
+    @property
+    def chip(self) -> "ChipSpec":
+        """Per-chip peak numbers for this topology's device kind."""
+        return chip_spec(self.device_kind)
+
+
+@dataclasses.dataclass(frozen=True)
+class ChipSpec:
+    """Per-chip peak performance numbers used by the tune/ cost model.
+
+    Bandwidths are bytes/s per chip (one direction); ``ici`` is the
+    intra-slice interconnect, ``dcn`` the data-center network between
+    slices/hosts.  Latencies are per-hop.  Public datasheet ballpark,
+    deliberately coarse: the cost model only needs relative magnitudes
+    to *rank* candidate plans, and ``tune/measure.py`` exists for the
+    cases where ranking by these numbers isn't enough.
+    """
+
+    flops_per_s: float  # peak dense bf16 matmul
+    hbm_bytes: int  # capacity (mirrors planner._HBM_BYTES)
+    hbm_bytes_per_s: float
+    ici_bytes_per_s: float
+    dcn_bytes_per_s: float
+    ici_latency_s: float = 1e-6
+    dcn_latency_s: float = 25e-6
+
+
+# Keyed by device-kind substring, like planner._HBM_BYTES.  The 'cpu'
+# entry models the 8-device host-platform sim: tiny compute, shared
+# memory "links" — numbers only need to keep ranking sane in CI.
+_CHIP_SPECS: dict[str, ChipSpec] = {
+    "v5 lite": ChipSpec(197e12, 16 * 2**30, 8.2e11, 1.86e11, 6.25e9),
+    "v5e": ChipSpec(197e12, 16 * 2**30, 8.2e11, 1.86e11, 6.25e9),
+    "v5p": ChipSpec(459e12, 95 * 2**30, 2.77e12, 4.8e11, 6.25e9),
+    "v4": ChipSpec(275e12, 32 * 2**30, 1.23e12, 3.0e11, 6.25e9),
+    "v6": ChipSpec(918e12, 32 * 2**30, 1.64e12, 3.58e11, 6.25e9),
+    "cpu": ChipSpec(5e10, 8 * 2**30, 2e10, 1e9, 1e8,
+                    ici_latency_s=5e-6, dcn_latency_s=100e-6),
+}
+
+_DEFAULT_CHIP = ChipSpec(1e14, 16 * 2**30, 8e11, 1e11, 6.25e9)
+
+
+def chip_spec(device_kind: str) -> ChipSpec:
+    """Look up :class:`ChipSpec` by device-kind substring (conservative
+    TPU-ish default for unknown kinds)."""
+    dk = device_kind.lower()
+    for k, v in _CHIP_SPECS.items():
+        if k in dk:
+            return v
+    return _DEFAULT_CHIP
+
 
 def detect(devices: Sequence[jax.Device] | None = None) -> Topology:
     """Discover the visible device topology.
@@ -259,7 +311,17 @@ def single_device_mesh(device: jax.Device | None = None) -> Mesh:
     )
 
 
-def mesh_degrees(mesh: Mesh) -> dict[str, int]:
+def mesh_degrees(mesh: Mesh | Mapping[str, int]) -> dict[str, int]:
+    """Axis-name -> degree of a ``Mesh``, or of a plain degrees mapping.
+
+    Accepting a mapping lets the planner's pure functions
+    (``param_spec_tree``, ``batch_partition_spec``,
+    ``expected_collective_bytes``) run on *hypothetical* meshes — the
+    tune/ subsystem scores candidate factorizations without ever
+    building a device array.
+    """
+    if isinstance(mesh, Mapping):
+        return {ax: int(n) for ax, n in mesh.items()}
     return {ax: int(n) for ax, n in zip(mesh.axis_names, mesh.devices.shape)}
 
 

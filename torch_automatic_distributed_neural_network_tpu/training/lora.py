@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import dataclasses
 import re
+import warnings
 from typing import Any, Callable, Sequence
 
 import jax
@@ -98,6 +99,32 @@ class LoraSpec:
                 return t
         return None
 
+    def check_matrix_view(self, path: str, shape) -> None:
+        """Warn when a plain-string target (implicit 2-D [in, out] view)
+        hits a kernel with more dims.  The (1, 1) split then treats every
+        leading dim as broadcast — on a 4-D DenseGeneral q/k/v kernel
+        ``[L, d_model, heads, hd]`` that builds per-d_model-row factors
+        LARGER than the frozen weight, silently destroying the parameter
+        efficiency LoRA exists for."""
+        for t in self.targets:
+            if not isinstance(t, str):
+                if re.search(t.pattern, path):
+                    return  # an explicit target wins the resolve
+                continue
+            if re.search(t, path) and len(shape) > 2:
+                warnings.warn(
+                    f"LoRA target {t!r} is a plain string (implicit 2-D "
+                    f"[in, out] matrix view) but matched {path} with "
+                    f"shape {tuple(shape)}: the extra leading dims become "
+                    "broadcast dims, so the rank-"
+                    f"{self.rank} factors can be larger than the kernel "
+                    "itself.  Use an explicit LoraTarget(pattern, "
+                    "in_dims, out_dims) — see KERNEL_MATRIX_VIEWS for "
+                    "the core kernel families.",
+                    stacklevel=3,
+                )
+                return
+
 
 def matrix_view(shape, target: LoraTarget):
     """(lead dims, d_in, d_out) of a kernel under ``target``'s split.
@@ -131,6 +158,7 @@ def init_lora_params(rng, base_params, spec: LoraSpec):
         target = spec.resolve(p)
         if target is None or jnp.ndim(leaf) < 2:
             continue
+        spec.check_matrix_view(p, jnp.shape(leaf))
         n += 1
         rng, sub = jax.random.split(rng)
         lead, d_in, d_out = matrix_view(jnp.shape(leaf), target)

@@ -28,6 +28,7 @@ import numpy as np
 
 from typing import TYPE_CHECKING
 
+from .. import topology as topo_mod
 from ..obs import GoodputMeter
 from ..obs import journal as obs_journal
 from .checkpoint import CheckpointManager, restore_or_init
@@ -190,9 +191,13 @@ class Trainer:
                 print(f"resumed from step {start}")
         else:
             start = int(state.step)
+        plan = self.ad.plan
         obs_journal.event(
             "run_start", start_step=start, steps=cfg.steps, resumed=resumed,
-            strategy=(self.ad.plan.strategy if self.ad.plan else None),
+            strategy=(plan.strategy if plan else None),
+            # mesh degrees tie the run to the (possibly tuned) plan so
+            # `tadnn report` can line it up with tune.* events
+            mesh=(dict(topo_mod.mesh_degrees(plan.mesh)) if plan else None),
         )
         last_done = start
 

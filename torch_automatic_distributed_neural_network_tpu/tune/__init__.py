@@ -1,0 +1,43 @@
+"""Cost-model-driven parallelism autotuner.
+
+Turns plan selection from a single hand-written heuristic
+(``planner.choose_strategy``) into enumerate -> score -> (optionally)
+measure -> cache:
+
+- :mod:`.space` — candidate mesh factorizations x strategy x tensor
+  degree x grad-accum, pruned by a per-device memory-fit estimate
+- :mod:`.cost` — analytic roofline step-time model (FLOPs, the
+  planner's collective-bytes estimate over per-link ICI/DCN bandwidth,
+  HBM pressure)
+- :mod:`.measure` — optional compile-and-time of the top-k candidates
+  (real train step; works on the CPU sim)
+- :mod:`.cache` — persistent JSONL decisions under ``~/.cache/tadnn/``
+  (``TADNN_TUNE_CACHE`` overrides)
+
+Use it implicitly with ``AutoDistribute(..., strategy='tuned')`` /
+``make_plan(strategy='tuned')``, or explicitly via :func:`tune` and the
+``tadnn tune`` CLI.  Decisions, cost breakdowns, and measured trials
+are journaled (``tune.*`` events) so ``tadnn report`` shows why a plan
+was chosen.
+"""
+
+from . import cache, cost, measure, space
+from .cost import CostEstimate, rank, score
+from .space import Candidate, enumerate_candidates, estimate_batch_items
+from .tuner import TunePolicy, TuneResult, tune
+
+__all__ = [
+    "Candidate",
+    "CostEstimate",
+    "TunePolicy",
+    "TuneResult",
+    "cache",
+    "cost",
+    "enumerate_candidates",
+    "estimate_batch_items",
+    "measure",
+    "rank",
+    "score",
+    "space",
+    "tune",
+]

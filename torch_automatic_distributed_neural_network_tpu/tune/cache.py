@@ -1,0 +1,103 @@
+"""Persistent tuning cache: (param signature, topology fingerprint,
+policy) -> winning plan, as append-only JSONL.
+
+Default location ``~/.cache/tadnn/tune_cache.jsonl``; override with the
+``TADNN_TUNE_CACHE`` env var (point different jobs at different files,
+or at /dev/null-ish paths in hermetic CI).  Append-only with
+last-match-wins semantics — concurrent writers at worst duplicate a
+line, they never corrupt a decision.
+
+The key hashes everything a decision depends on, so any change —
+different model shapes, different device count/kind/slicing, different
+search policy — misses cleanly instead of replaying a stale plan.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Any, Mapping
+
+import jax
+
+from .. import planner
+from .. import topology as topo_mod
+
+_ENV = "TADNN_TUNE_CACHE"
+_DEFAULT = "~/.cache/tadnn/tune_cache.jsonl"
+
+
+def cache_path(path: str | None = None) -> str:
+    return os.path.expanduser(path or os.environ.get(_ENV) or _DEFAULT)
+
+
+def params_signature(abstract_params: Any) -> str:
+    """Stable digest of the abstract param tree (paths, shapes, dtypes)."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(abstract_params)
+    entries = sorted(
+        (
+            planner.path_str(keypath),
+            list(getattr(leaf, "shape", ()) or ()),
+            str(getattr(leaf, "dtype", "float32")),
+        )
+        for keypath, leaf in flat
+    )
+    digest = hashlib.sha256(
+        json.dumps(entries, sort_keys=True).encode()
+    )
+    return digest.hexdigest()[:16]
+
+
+def topology_fingerprint(topo: topo_mod.Topology) -> dict:
+    return {
+        "num_devices": topo.num_devices,
+        "num_hosts": topo.num_hosts,
+        "platform": topo.platform,
+        "device_kind": topo.device_kind,
+        "num_slices": topo.num_slices,
+    }
+
+
+def cache_key(
+    signature: str, topo_fp: Mapping, policy: Mapping | Any
+) -> str:
+    if dataclasses.is_dataclass(policy) and not isinstance(policy, type):
+        policy = dataclasses.asdict(policy)
+    blob = json.dumps(
+        {"params": signature, "topology": dict(topo_fp),
+         "policy": {k: list(v) if isinstance(v, tuple) else v
+                    for k, v in dict(policy).items()}},
+        sort_keys=True,
+    )
+    return hashlib.sha256(blob.encode()).hexdigest()[:32]
+
+
+def lookup(key: str, path: str | None = None) -> dict | None:
+    """Latest cached record for ``key``, or None."""
+    p = cache_path(path)
+    if not os.path.isfile(p):
+        return None
+    hit = None
+    with open(p) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn concurrent write — skip the line
+            if rec.get("key") == key:
+                hit = rec.get("record")
+    return hit
+
+
+def store(key: str, record: Mapping, path: str | None = None) -> str:
+    """Append a decision; returns the file written."""
+    p = cache_path(path)
+    os.makedirs(os.path.dirname(p) or ".", exist_ok=True)
+    with open(p, "a") as f:
+        f.write(json.dumps({"key": key, "record": dict(record)}) + "\n")
+    return p

@@ -1,0 +1,98 @@
+"""Measured calibration: compile-and-time the top-k tuner candidates.
+
+The analytic model ranks cheaply; this module answers "but is it
+actually faster?" by running a short real microbenchmark of the actual
+train step per candidate — init, warmup (compile), then a few timed
+steps.  Works on the CPU host-platform sim (CI) exactly as on TPU.
+
+Every trial is journaled as an obs span (``tune.trial``) with the
+measured milliseconds and, when the backend exposes it, the XLA
+cost-analysis FLOPs of the compiled step
+(``utils.profiling.compiled_cost``) — so ``tadnn report`` can show the
+trials next to the analytic decision.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Sequence
+
+import jax
+
+from ..obs import journal as obs_journal
+from .space import Candidate
+
+
+def time_step(ad, state, batch, *, warmup: int = 1, iters: int = 3) -> float:
+    """Median wall-clock seconds of ``ad.step`` after warmup."""
+    for _ in range(max(1, warmup)):
+        state, _ = ad.step(state, batch)
+    jax.block_until_ready(state.params)
+    times = []
+    for _ in range(max(1, iters)):
+        t0 = time.perf_counter()
+        state, _ = ad.step(state, batch)
+        jax.block_until_ready(state.params)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2]
+
+
+def measure_candidates(
+    candidates: Sequence[Candidate],
+    make_ad: Callable[[Candidate], Any],
+    rng: jax.Array,
+    sample_batch: Any,
+    *,
+    warmup: int = 1,
+    iters: int = 3,
+) -> list[dict]:
+    """Run the microbenchmark for each candidate.
+
+    ``make_ad(candidate)`` must return a fresh ``AutoDistribute``
+    configured for that candidate (strategy + mesh built from its
+    degrees + its grad_accum).  A candidate that fails to build or OOMs
+    is reported with its error instead of aborting the sweep — the
+    analytic ranking already called it plausible; measurement is where
+    reality gets a veto.
+    """
+    results: list[dict] = []
+    for cand in candidates:
+        fields = {
+            "candidate": cand.label(),
+            "strategy": cand.strategy,
+            "mesh": cand.degrees_dict,
+            "grad_accum": cand.grad_accum,
+        }
+        with obs_journal.span("tune.trial", **fields):
+            entry = dict(fields)
+            try:
+                ad = make_ad(cand)
+                state = ad.init(rng, sample_batch)
+                step_s = time_step(
+                    ad, state, sample_batch, warmup=warmup, iters=iters
+                )
+                entry["step_time_s"] = step_s
+                entry["step_time_ms"] = round(step_s * 1e3, 3)
+                flops = _compiled_flops(ad, state, sample_batch)
+                if flops is not None:
+                    entry["compiled_flops"] = flops
+            except Exception as e:  # noqa: BLE001 — a veto, not a crash
+                entry["error"] = f"{type(e).__name__}: {e}"
+            obs_journal.event("tune.trial.result", **entry)
+            results.append(entry)
+    return results
+
+
+def _compiled_flops(ad, state, batch) -> float | None:
+    """XLA cost-analysis FLOPs of the compiled step, if the backend
+    exposes them (utils.profiling.compiled_cost; AOT, hits the jit
+    cache so no recompile)."""
+    try:
+        from ..utils import profiling
+
+        cost = profiling.compiled_cost(ad._step_fn, state, batch) or {}
+        flops = cost.get("flops")
+        return float(flops) if flops else None
+    except Exception:
+        return None

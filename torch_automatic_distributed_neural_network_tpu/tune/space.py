@@ -1,0 +1,244 @@
+"""Candidate search space for the parallelism autotuner.
+
+Enumerates every plan the framework could actually build on this
+topology: divisor splits of the device count across strategies
+(dp / fsdp / tp_fsdp / ep / ep_fsdp) x tensor degree x grad-accum
+choice, then prunes by a per-device memory-fit estimate — params +
+grads + optimizer state through the planner's real ``param_spec_tree``
+sharding math (so indivisible dims that stay replicated are charged
+correctly) plus a coarse activation estimate.
+
+Everything here is pure shape math: candidates are scored on a degrees
+*mapping*, never a built ``Mesh`` (topology.mesh_degrees accepts both),
+so enumeration works for hypothetical topologies in unit tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from .. import planner
+from .. import topology as topo_mod
+
+# Per-device items assumed when the caller gives no batch: enough that
+# compute (not fixed overhead) dominates the analytic step time.
+DEFAULT_BATCH_ITEMS = 4096
+
+# Fraction of HBM a candidate's state + activations may claim (matches
+# the spirit of core.AutoDistribute's search-ladder safety margin).
+MEMORY_SAFETY = 0.9
+
+# Activation shrink under gradient checkpointing: only boundary
+# activations are stored, the rest recomputed in backward.
+REMAT_ACT_FACTOR = 0.25
+
+
+@dataclasses.dataclass(frozen=True)
+class Candidate:
+    """One point of the search space: a strategy, its mesh-axis degrees
+    (only non-trivial axes listed, ordered like MESH_AXES), and a
+    grad-accumulation choice."""
+
+    strategy: str
+    degrees: tuple[tuple[str, int], ...]
+    grad_accum: int = 1
+
+    @property
+    def degrees_dict(self) -> dict[str, int]:
+        return dict(self.degrees)
+
+    def full_degrees(self) -> dict[str, int]:
+        """Degrees over every canonical axis (unlisted axes -> 1)."""
+        d = dict(self.degrees)
+        return {ax: int(d.get(ax, 1)) for ax in topo_mod.MESH_AXES}
+
+    def label(self) -> str:
+        mesh = "x".join(f"{ax}{n}" for ax, n in self.degrees if n > 1)
+        s = f"{self.strategy}[{mesh or '1'}]"
+        if self.grad_accum > 1:
+            s += f"/ga{self.grad_accum}"
+        return s
+
+
+def _degrees_key(strategy: str, degrees: dict[str, int]) -> tuple:
+    return (strategy,
+            tuple(sorted((a, n) for a, n in degrees.items() if n > 1)))
+
+
+def _as_candidate(strategy: str, degrees: dict[str, int],
+                  grad_accum: int) -> Candidate:
+    ordered = tuple(
+        (ax, int(degrees[ax]))
+        for ax in topo_mod.MESH_AXES
+        if degrees.get(ax, 1) >= 1 and ax in degrees
+    )
+    return Candidate(strategy=strategy, degrees=ordered,
+                     grad_accum=grad_accum)
+
+
+def estimate_batch_items(batch: Any) -> int:
+    """Items per global step implied by a sample batch: tokens for LM
+    batches ([B, S] integer ids), leading-dim rows otherwise."""
+    best = 1
+    for leaf in jax.tree.leaves(batch):
+        shape = tuple(getattr(leaf, "shape", ()))
+        if not shape:
+            continue
+        dtype = np.dtype(getattr(leaf, "dtype", np.float32))
+        if np.issubdtype(dtype, np.integer) and len(shape) >= 2:
+            best = max(best, int(shape[0]) * int(shape[1]))
+        else:
+            best = max(best, int(shape[0]))
+    return best
+
+
+def _model_width(abstract_params: Any) -> int:
+    """Modal trailing dim of matrix params — a d_model estimate."""
+    counts: dict[int, int] = {}
+    for leaf in jax.tree.leaves(abstract_params):
+        shape = tuple(getattr(leaf, "shape", ()))
+        if len(shape) >= 2:
+            counts[int(shape[-1])] = counts.get(int(shape[-1]), 0) + 1
+    if not counts:
+        return 1
+    return max(counts, key=lambda k: (counts[k], k))
+
+
+def activation_bytes(
+    abstract_params: Any,
+    items_per_device: float,
+    *,
+    itemsize: int = 4,
+    remat: bool = False,
+) -> int:
+    """Coarse per-device activation estimate.
+
+    Every matmul writes one activation row per item; total activation
+    elements per item ~ param_count / d_model (exact for a stack of
+    square-ish matmuls, order-of-magnitude elsewhere — which is all a
+    fit *estimate* needs).
+    """
+    param_count = sum(
+        math.prod(getattr(leaf, "shape", ()) or (1,))
+        for leaf in jax.tree.leaves(abstract_params)
+    )
+    per_item = param_count / max(1, _model_width(abstract_params))
+    est = itemsize * items_per_device * per_item
+    return int(est * (REMAT_ACT_FACTOR if remat else 1.0))
+
+
+def candidate_memory(
+    abstract_params: Any,
+    cand: Candidate,
+    *,
+    state_factor: float = 4.0,
+    batch_items: int | None = None,
+    rules: Sequence[planner.Rule] = planner.TRANSFORMER_RULES,
+    remat: bool = True,
+) -> dict:
+    """Per-device memory estimate for a candidate, via the planner's own
+    spec assignment (replicated-because-indivisible dims are charged in
+    full, exactly as GSPMD would lay them out)."""
+    degrees = cand.full_degrees()
+    specs = planner.param_spec_tree(
+        abstract_params, degrees, cand.strategy, rules
+    )
+    spec_leaves = jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P))
+    leaves = jax.tree.leaves(abstract_params)
+    param_b = 0.0
+    for spec, leaf in zip(spec_leaves, leaves):
+        shape = tuple(getattr(leaf, "shape", ()))
+        itemsize = np.dtype(getattr(leaf, "dtype", np.float32)).itemsize
+        nbytes = (math.prod(shape) if shape else 1) * itemsize
+        frac = 1
+        for ax in planner._spec_axes(spec):
+            frac *= degrees.get(ax, 1)
+        param_b += nbytes / max(1, frac)
+    state_b = state_factor * param_b
+    batch_deg = math.prod(
+        degrees.get(a, 1) for a in ("data", "fsdp", "expert")
+    )
+    items = (batch_items or DEFAULT_BATCH_ITEMS) / max(1, batch_deg)
+    items /= max(1, cand.grad_accum)
+    act_b = activation_bytes(abstract_params, items, remat=remat)
+    return {
+        "param_bytes": int(param_b),
+        "state_bytes": int(state_b),
+        "activation_bytes": int(act_b),
+        "total_bytes": int(state_b + act_b),
+    }
+
+
+def hbm_budget(topo: topo_mod.Topology, safety: float = MEMORY_SAFETY) -> int:
+    return int(safety * topo.chip.hbm_bytes)
+
+
+def enumerate_candidates(
+    abstract_params: Any,
+    topo: topo_mod.Topology,
+    *,
+    rules: Sequence[planner.Rule] = planner.TRANSFORMER_RULES,
+    grad_accums: Sequence[int] = (1,),
+    max_tensor: int = 8,
+    state_factor: float = 4.0,
+    batch_items: int | None = None,
+    safety: float = MEMORY_SAFETY,
+) -> tuple[list[Candidate], list[tuple[Candidate, str]]]:
+    """(kept, pruned) candidates for this model on this topology.
+
+    ``kept`` passes the per-device memory-fit estimate; ``pruned``
+    carries a human-readable reason per dropped candidate so the CLI
+    can show *why* the space shrank.
+    """
+    n = topo.num_devices
+    raw: list[tuple[str, dict[str, int]]] = []
+    seen: set = set()
+
+    def add(strategy: str, degrees: dict[str, int]) -> None:
+        key = _degrees_key(strategy, degrees)
+        if key not in seen and math.prod(degrees.values()) == n:
+            seen.add(key)
+            raw.append((strategy, degrees))
+
+    divisors = [d for d in range(1, n + 1) if n % d == 0]
+    add("dp", {"data": n})
+    if n > 1:
+        add("fsdp", {"fsdp": n})
+    if planner.tp_applicable(abstract_params, rules):
+        for t in divisors:
+            if 2 <= t <= max_tensor and n // t >= 2:
+                add("tp_fsdp", {"tensor": t, "fsdp": n // t})
+    e_count = planner.detect_expert_count(abstract_params)
+    if e_count:
+        g = math.gcd(n, e_count)
+        for e in divisors:
+            if e >= 2 and g % e == 0:
+                add("ep", {"expert": e, "data": n // e})
+                if n // e >= 2:
+                    add("ep_fsdp", {"expert": e, "fsdp": n // e})
+
+    budget = hbm_budget(topo, safety)
+    kept: list[Candidate] = []
+    pruned: list[tuple[Candidate, str]] = []
+    for strategy, degrees in raw:
+        for ga in grad_accums:
+            cand = _as_candidate(strategy, degrees, int(ga))
+            mem = candidate_memory(
+                abstract_params, cand, state_factor=state_factor,
+                batch_items=batch_items, rules=rules,
+            )
+            if mem["total_bytes"] > budget:
+                pruned.append((cand, (
+                    f"memory: ~{mem['total_bytes'] / 2**30:.2f} GiB "
+                    f"(state {mem['state_bytes'] / 2**30:.2f} + act "
+                    f"{mem['activation_bytes'] / 2**30:.2f}) > budget "
+                    f"{budget / 2**30:.2f} GiB")))
+            else:
+                kept.append(cand)
+    return kept, pruned
