@@ -54,6 +54,9 @@ class RunCfg:
     metrics_path: str = ""
     ckpt_dir: str = ""
     ckpt_every: int = 0
+    # restarts allowed per rolling hour (needs ckpt_dir); <0 = no recovery
+    max_restarts: int = -1
+    anomaly_rollback: bool = False  # loss NaN/spike -> restore + skip batch
 
 
 @dataclasses.dataclass(frozen=True)
@@ -128,16 +131,37 @@ def main():
         )
 
         ckpt = CheckpointManager(cfg.run.ckpt_dir)
+    anomaly = None
+    if cfg.run.anomaly_rollback:
+        from torch_automatic_distributed_neural_network_tpu.training import (
+            AnomalyConfig,
+        )
+
+        anomaly = AnomalyConfig()
     trainer = Trainer(
         ad,
         TrainerConfig(steps=cfg.run.steps, log_every=cfg.run.log_every,
-                      ckpt_every=cfg.run.ckpt_every),
+                      ckpt_every=cfg.run.ckpt_every, anomaly=anomaly),
         metrics=metrics,
         ckpt=ckpt,
         items_per_step=tokens_per_step,
         run_config=cfglib.to_dict(cfg),
     )
-    state = trainer.fit(data)  # step-indexed: elastic resume replays batches
+    if cfg.run.max_restarts >= 0:
+        from torch_automatic_distributed_neural_network_tpu.training import (
+            RestartPolicy,
+            run_with_recovery,
+        )
+
+        # step-indexed data + restore_or_init make fit() re-entrant: each
+        # retry resumes from the newest intact checkpoint
+        state = run_with_recovery(
+            lambda: trainer.fit(data),
+            policy=RestartPolicy(max_restarts=cfg.run.max_restarts,
+                                 window_s=3600.0),
+        )
+    else:
+        state = trainer.fit(data)  # step-indexed: resume replays batches
     print(f"plan: {ad.plan.strategy} mesh={tad.mesh_degrees(ad.plan.mesh)} "
           f"params={mcfg.num_params()/1e6:.0f}M final_step={int(state.step)}")
 

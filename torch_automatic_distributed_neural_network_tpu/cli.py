@@ -323,6 +323,20 @@ def cmd_report(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_doctor(args: argparse.Namespace) -> int:
+    """Verify a checkpoint directory's integrity and print the fallback
+    chain restore_or_init would walk.  Exit 0 when at least one step is
+    restorable, 1 otherwise (corrupt-only or empty directory)."""
+    from .training import resilience
+
+    report = resilience.verify_directory(args.directory)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(resilience.format_doctor(report))
+    return 0 if report["healthy"] else 1
+
+
 def cmd_tokenize(args: argparse.Namespace) -> int:
     """Text -> TADN token file (data/text.py)."""
     from .data.text import load_tokenizer, tokenize_file
@@ -431,6 +445,16 @@ def main(argv: list[str] | None = None) -> int:
                    help="explicit MetricsLogger JSONL path")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_report)
+
+    p = sub.add_parser(
+        "doctor",
+        help="verify a checkpoint directory (per-leaf integrity "
+             "manifests, resilience.py) and print the fallback chain; "
+             "exits nonzero when no step is restorable",
+    )
+    p.add_argument("directory", help="CheckpointManager directory")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_doctor)
 
     p = sub.add_parser(
         "tokenize",

@@ -169,11 +169,38 @@ def generate(target: str, metrics_path: str | None = None) -> dict:
         ]
     stalls = [e for e in events if e.get("name") == "watchdog.stall"]
     restarts = [e for e in events if e.get("name") == "elastic.restart"]
-    if stalls or restarts:
+    corrupt = [e for e in events if e.get("name") == "ckpt.corrupt"]
+    rollbacks = [e for e in events
+                 if e.get("name") == "resilience.rollback"]
+    chaos = [e for e in events if e.get("name") == "resilience.chaos"]
+    escalations = [e for e in events
+                   if e.get("name") == "resilience.stall_escalation"]
+    exhausted = [e for e in events if e.get("name") == "data_exhausted"]
+    if (stalls or restarts or corrupt or rollbacks or chaos
+            or escalations or exhausted):
         report["incidents"] = {
             "watchdog_stalls": len(stalls),
             "elastic_restarts": len(restarts),
+            "corrupt_checkpoints": len(corrupt),
+            "anomaly_rollbacks": len(rollbacks),
+            "chaos_faults": len(chaos),
+            "stall_escalations": len(escalations),
+            "data_exhausted": len(exhausted),
         }
+        detail = []
+        for e in corrupt:
+            detail.append({"what": "ckpt.corrupt", "step": e.get("step"),
+                           "reason": e.get("reason")})
+        for e in rollbacks:
+            detail.append({"what": "rollback", "reason": e.get("reason"),
+                           "at_step": e.get("at_step"),
+                           "to_step": e.get("to_step"),
+                           "skipped_batches": e.get("skipped_batches")})
+        if detail:
+            report["incident_detail"] = detail
+        gave_up = [e for e in restarts if e.get("gave_up")]
+        if restarts:
+            report["incidents"]["restarts_gave_up"] = len(gave_up)
     if metrics_path and os.path.isfile(metrics_path):
         recs = _read_metrics(metrics_path)
         steps = [r for r in recs if "step_time_s" in r]
@@ -291,8 +318,27 @@ def format_report(report: dict) -> str:
         )
     inc = report.get("incidents")
     if inc:
-        lines.append(f"incidents: {inc['watchdog_stalls']} watchdog stalls, "
-                     f"{inc['elastic_restarts']} elastic restarts")
+        parts = [f"{inc['watchdog_stalls']} watchdog stalls",
+                 f"{inc['elastic_restarts']} elastic restarts"]
+        for key, label in (("corrupt_checkpoints", "corrupt checkpoints"),
+                           ("anomaly_rollbacks", "anomaly rollbacks"),
+                           ("chaos_faults", "chaos faults"),
+                           ("stall_escalations", "stall escalations"),
+                           ("data_exhausted", "data exhaustions")):
+            if inc.get(key):
+                parts.append(f"{inc[key]} {label}")
+        if inc.get("restarts_gave_up"):
+            parts.append(f"{inc['restarts_gave_up']} gave up (budget)")
+        lines.append("incidents: " + ", ".join(parts))
+        for d in report.get("incident_detail", [])[-4:]:
+            if d["what"] == "ckpt.corrupt":
+                lines.append(f"  ckpt.corrupt step {d.get('step')}: "
+                             f"{d.get('reason')}")
+            else:
+                lines.append(
+                    f"  rollback ({d.get('reason')}): step "
+                    f"{d.get('at_step')} -> {d.get('to_step')}, skipped "
+                    f"{d.get('skipped_batches')} batch(es)")
     bi = report.get("bench_incidents")
     if bi:
         lines.append(f"bench incidents: {len(bi)}")

@@ -41,6 +41,16 @@ _LAZY = {
     "PreemptionGuard": "elastic",
     "StepWatchdog": "elastic",
     "run_with_recovery": "elastic",
+    "AnomalyConfig": "resilience",
+    "ChaosData": "resilience",
+    "ChaosFault": "resilience",
+    "ChaosInjector": "resilience",
+    "ChaosPlan": "resilience",
+    "CheckpointCorruptError": "resilience",
+    "RestartPolicy": "resilience",
+    "StallError": "resilience",
+    "tear_checkpoint": "resilience",
+    "verify_directory": "resilience",
 }
 
 __all__ = [

@@ -20,9 +20,17 @@ import jax
 import orbax.checkpoint as ocp
 
 from ..obs import journal as obs_journal
+from . import resilience
 
 if TYPE_CHECKING:  # runtime import would be circular (core -> training)
     from ..core import AutoDistribute, TrainState
+
+# the orbax restore path surfaces torn/corrupt steps as a zoo of types
+# (JSONDecodeError on torn metadata, KeyError on missing items, OSError/
+# FileNotFoundError on missing files, array-decode ValueErrors); this is
+# the set the fallback chain treats as "this step is bad, try an older
+# one" and restore_config treats as "no config"
+RESTORE_ERRORS = (OSError, ValueError, KeyError, TypeError, IndexError)
 
 
 def _is_key(x: Any) -> bool:
@@ -77,6 +85,12 @@ class CheckpointManager:
     Typed PRNG-key leaves (``jax.random.key``) are transparently stored
     as their raw uint32 key data and re-wrapped on restore — the key
     dtype itself is not serializable by every orbax version.
+
+    With ``integrity=True`` (default) every save also writes a per-leaf
+    sha256 manifest (``manifest-<step>.json``, resilience.py) and
+    restore verifies the restored leaves against it, raising
+    :class:`resilience.CheckpointCorruptError` on mismatch.  Steps saved
+    without a manifest restore unverified (legacy compatibility).
     """
 
     def __init__(
@@ -85,8 +99,10 @@ class CheckpointManager:
         *,
         max_to_keep: int = 3,
         save_interval_steps: int = 0,
+        integrity: bool = True,
     ):
         self.directory = os.path.abspath(directory)
+        self.integrity = integrity
         os.makedirs(self.directory, exist_ok=True)
         self._mngr = ocp.CheckpointManager(
             self.directory,
@@ -100,8 +116,9 @@ class CheckpointManager:
 
     def save(self, step: int, state: "TrainState", config: dict | None = None,
              force: bool = False) -> bool:
+        encoded = _encode_keys(state)
         args = {
-            "state": ocp.args.StandardSave(_encode_keys(state)),
+            "state": ocp.args.StandardSave(encoded),
             "config": ocp.args.JsonSave(config if config is not None else {}),
         }
         # span covers only save *dispatch* — async commit lands in wait()
@@ -109,23 +126,76 @@ class CheckpointManager:
             saved = self._mngr.save(step, args=ocp.args.Composite(**args),
                                     force=force)
             rec["saved"] = bool(saved)
+            # leaf hashing needs fully-addressable arrays: single-
+            # controller-with-every-shard-visible only (the CPU sim and
+            # single-host TPU runs); multi-host integrity would need a
+            # per-host shard manifest
+            if saved and self.integrity and jax.process_count() == 1:
+                # checksums come from the in-memory values being saved,
+                # so the manifest is valid even while the async commit
+                # is still in flight
+                resilience.write_manifest(self.directory, step, encoded)
+                rec["manifest"] = True
+                self._gc_manifests()
         return saved
+
+    def _gc_manifests(self) -> None:
+        """Drop manifests for steps orbax's max_to_keep GC removed."""
+        kept = set(self._mngr.all_steps())
+        import glob
+
+        for path in glob.glob(os.path.join(self.directory, "manifest-*.json")):
+            name = os.path.basename(path)
+            try:
+                step = int(name[len("manifest-"):-len(".json")])
+            except ValueError:
+                continue
+            if step not in kept:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
 
     def latest_step(self) -> int | None:
         return self._mngr.latest_step()
+
+    def all_steps(self) -> list[int]:
+        return sorted(self._mngr.all_steps())
+
+    def reload(self) -> None:
+        """Re-scan the directory (after an external change, e.g. a
+        quarantine rename)."""
+        self._mngr.reload()
+
+    def quarantine(self, step: int, reason: str = "") -> None:
+        """Move a corrupt step out of the chain (resilience.py) and
+        resync orbax's view of the directory."""
+        self._mngr.wait_until_finished()  # never rename under a writer
+        resilience.quarantine_step(self.directory, step, reason)
+        self._mngr.reload()
 
     def restore(
         self,
         abstract_state: Any,
         step: int | None = None,
+        *,
+        verify: bool | None = None,
     ) -> "TrainState":
         """Restore into the given abstract state (ShapeDtypeStructs carrying
         target shardings) — resharding happens inside Orbax when the target
-        mesh differs from the one the checkpoint was written on."""
+        mesh differs from the one the checkpoint was written on.
+
+        ``verify`` (default: the manager's ``integrity`` flag) re-hashes
+        every restored leaf against the step's integrity manifest; a
+        mismatch raises CheckpointCorruptError.  Steps without a
+        manifest pass through unverified.
+        """
         step = self._mngr.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"No checkpoint found in {self.directory}")
-        with obs_journal.span("ckpt.restore", step=step):
+        verify = self.integrity if verify is None else verify
+        verify = verify and jax.process_count() == 1  # see save()
+        with obs_journal.span("ckpt.restore", step=step) as rec:
             out = self._mngr.restore(
                 step,
                 args=ocp.args.Composite(
@@ -134,6 +204,18 @@ class CheckpointManager:
                     )
                 ),
             )
+            manifest = (resilience.read_manifest(self.directory, step)
+                        if verify else None)
+            if manifest is not None:
+                problems = resilience.verify_tree(out["state"], manifest)
+                rec["verified"] = not problems
+                if problems:
+                    raise resilience.CheckpointCorruptError(
+                        f"step {step} failed integrity verification: "
+                        + "; ".join(problems[:4])
+                        + (f" (+{len(problems) - 4} more)"
+                           if len(problems) > 4 else "")
+                    )
         return _decode_keys(out["state"], abstract_state)
 
     def restore_config(self, step: int | None = None) -> dict | None:
@@ -145,7 +227,13 @@ class CheckpointManager:
                 step, args=ocp.args.Composite(config=ocp.args.JsonRestore())
             )
             return out.get("config")
-        except Exception:
+        except RESTORE_ERRORS as e:
+            # a missing/torn config item is survivable (the caller gets
+            # None and proceeds with defaults) but never silent
+            obs_journal.event(
+                "ckpt.restore_config_failed", step=int(step),
+                error=f"{type(e).__name__}: {e}",
+            )
             return None
 
     def wait(self) -> None:
@@ -197,11 +285,27 @@ def restore_or_init(
     rng,
     sample_batch,
 ) -> "tuple[TrainState, bool]":
-    """Resume from the latest checkpoint if one exists, else fresh init.
-    Returns (state, resumed).  The jitted step is compiled either way."""
-    if ckpt is not None and ckpt.latest_step() is not None:
-        abstract = abstract_state_for(ad, rng, sample_batch)
-        state = ckpt.restore(abstract)
+    """Resume from the newest *intact* checkpoint, else fresh init.
+    Returns (state, resumed).  The jitted step is compiled either way.
+
+    Fallback chain (resilience.py): the latest step is tried first; a
+    step that fails to restore or fails integrity verification is
+    quarantined (renamed ``<step>.corrupt``, ``ckpt.corrupt`` journal
+    event) and the next-older step is tried, so a partial write during
+    preemption degrades to losing one save interval instead of the run.
+    """
+    if ckpt is None or ckpt.latest_step() is None:
+        return ad.init(rng, sample_batch), False
+    abstract = abstract_state_for(ad, rng, sample_batch)
+    while True:
+        step = ckpt.latest_step()
+        if step is None:
+            break
+        try:
+            state = ckpt.restore(abstract, step=step)
+        except (resilience.CheckpointCorruptError, *RESTORE_ERRORS) as e:
+            ckpt.quarantine(step, reason=f"{type(e).__name__}: {e}")
+            continue
         # compile the step against the restored abstract state
         shardings = ad.state_shardings(abstract)
         ad._compile_step(abstract, shardings)

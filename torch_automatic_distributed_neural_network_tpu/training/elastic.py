@@ -39,6 +39,7 @@ from typing import Any, Callable
 import jax
 
 from ..obs import journal as obs_journal
+from .resilience import RestartPolicy, StallError
 
 
 class InjectedFault(RuntimeError):
@@ -109,7 +110,12 @@ class Heartbeat:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=self.interval_s + 1)
-        self._write()  # final beat records the last step
+        try:
+            self._write()  # final beat records the last step
+        except OSError:
+            # best-effort: a torn-down/unmounted shared dir at shutdown
+            # must not turn a clean exit into a crash
+            pass
 
     def __enter__(self) -> "Heartbeat":
         return self.start()
@@ -261,6 +267,11 @@ class PreemptionGuard:
             f"checkpoint and exit after the current step",
             file=sys.stderr, flush=True,
         )
+        # compose with an outer supervisor: chain to whatever handler
+        # was installed before us (SIG_DFL/SIG_IGN are ints, skipped)
+        prev = self._prev.get(signum)
+        if callable(prev):
+            prev(signum, frame)
 
     def request(self) -> None:
         """Trip the drain flag programmatically (tests, cluster agents)."""
@@ -290,11 +301,12 @@ def run_with_recovery(
     *,
     max_restarts: int = 2,
     retriable: tuple[type[BaseException], ...] = (
-        RuntimeError,  # wedged runtime / hung collective / InjectedFault
+        RuntimeError,  # wedged runtime / hung collective / Injected/Stall
         OSError,       # lost shared storage, dropped connections
         TimeoutError,
     ),
     on_restart: Callable[[int, BaseException], None] | None = None,
+    policy: RestartPolicy | None = None,
 ) -> Any:
     """Invoke ``fit`` and restart it after retriable failures.
 
@@ -302,6 +314,15 @@ def run_with_recovery(
     CheckpointManager, which restores the latest checkpoint on re-entry
     (restore_or_init).  Elastic resume onto a different mesh works because
     restore takes the *target* shardings (checkpoint.py docstring).
+
+    ``policy`` (resilience.RestartPolicy) adds exponential backoff with
+    deterministic jitter and a restart budget over a rolling window; it
+    owns ``max_restarts`` when given.  Without one, the legacy behavior
+    is kept: up to ``max_restarts`` immediate retries (no backoff, no
+    window — every failure counts forever).  StallError from the
+    watchdog-escalation hook (trainer ``watchdog_escalate``) is a
+    RuntimeError, so a hung run killed by its own watchdog lands on
+    this same retriable path.
 
     The default ``retriable`` set covers infrastructure-style failures
     only: deterministic errors — the trainer's NaN guard
@@ -311,23 +332,37 @@ def run_with_recovery(
     ``retriable=(Exception,)``) if your data source is nondeterministic
     and a retry can genuinely change the outcome.
     """
+    if policy is None:
+        # legacy semantics: immediate retries, budget over all time
+        policy = RestartPolicy(max_restarts=max_restarts,
+                               window_s=float("inf"),
+                               backoff_base_s=0.0, jitter=0.0)
     attempt = 0
     while True:
         try:
             return fit()
         except retriable as e:
             attempt += 1
+            gave_up = policy.note_failure()
+            delay = 0.0 if gave_up else policy.delay_s(attempt)
             obs_journal.event(
                 "elastic.restart", attempt=attempt,
-                max_restarts=max_restarts,
+                max_restarts=policy.max_restarts,
+                window_failures=policy.recent_failures,
+                delay_s=delay,
                 error=f"{type(e).__name__}: {e}",
-                gave_up=attempt > max_restarts,
+                gave_up=gave_up,
             )
-            if attempt > max_restarts:
+            if gave_up:
                 raise
             if on_restart is not None:
                 on_restart(attempt, e)
             elif jax.process_index() == 0:
-                print(f"[tadnn elastic] restart {attempt}/{max_restarts} "
-                      f"after {type(e).__name__}: {e}", file=sys.stderr,
-                      flush=True)
+                print(f"[tadnn elastic] restart {attempt}"
+                      f"/{policy.max_restarts} (window "
+                      f"{policy.recent_failures}) after "
+                      f"{type(e).__name__}: {e}"
+                      + (f"; backing off {delay:.2f}s" if delay else ""),
+                      file=sys.stderr, flush=True)
+            if delay > 0:
+                policy.sleep(delay)

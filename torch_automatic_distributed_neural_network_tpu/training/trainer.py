@@ -8,6 +8,10 @@ Guards replacing the reference-world sanitizers in a single-controller
 model (SURVEY.md §5 'race detection'):
 
 - NaN/Inf loss detection with a configurable action (raise/warn);
+- anomaly rollback (``cfg.anomaly``): rolling loss statistics; on a
+  spike or NaN the last verified checkpoint is restored and the
+  offending batch window skipped (resilience.py) — recovery instead of
+  a crash, deterministic under step-indexed data;
 - cross-host parameter-divergence check every ``divergence_every`` steps
   (hash of params compared across hosts — catches drifting hosts, the
   single-controller analog of a NCCL desync);
@@ -19,6 +23,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import sys
 import time
 from typing import Any, Callable, Iterable
 
@@ -31,8 +36,14 @@ from typing import TYPE_CHECKING
 from .. import topology as topo_mod
 from ..obs import GoodputMeter
 from ..obs import journal as obs_journal
-from .checkpoint import CheckpointManager, restore_or_init
+from .checkpoint import RESTORE_ERRORS, CheckpointManager, restore_or_init
 from .metrics import MetricsLogger
+from .resilience import (
+    AnomalyConfig,
+    AnomalyGuard,
+    CheckpointCorruptError,
+    StallError,
+)
 
 if TYPE_CHECKING:  # runtime import would be circular (core -> training)
     from ..core import AutoDistribute, TrainState
@@ -46,7 +57,14 @@ class TrainerConfig:
     ckpt_every: int = 0  # 0 = no checkpointing
     nan_action: str = "raise"  # 'raise' | 'warn' | 'ignore'
     divergence_every: int = 0  # 0 = off; N = check params hash every N
+    # None = off; AnomalyConfig() = rollback-on-loss-anomaly (checks the
+    # loss every step, which syncs host and device — resilience.py)
+    anomaly: AnomalyConfig | None = None
     watchdog_timeout_s: float = 0.0  # 0 = off; stall detector (elastic.py)
+    # escalate a watchdog stall into a StallError raised in the training
+    # thread, feeding run_with_recovery's retriable path instead of only
+    # reporting to stderr
+    watchdog_escalate: bool = False
     heartbeat_dir: str = ""  # "" = off; shared-dir liveness beats
     eval_every: int = 0  # 0 = off; run evaluate(eval_data) every N steps
     eval_batches: int = 8  # batches per periodic evaluation
@@ -95,6 +113,7 @@ class Trainer:
         self.journal = journal  # installed as the default sink during fit()
         self.goodput: dict | None = None  # last fit()'s wall-clock breakdown
         self.preempt = None  # PreemptionGuard, installed during fit()
+        self._batch_offset = 0  # anomaly rollback's batch-window skip
 
     def evaluate(
         self, data: Any, n_batches: int, *, state: "TrainState",
@@ -176,9 +195,14 @@ class Trainer:
         data_iter = None if indexed else iter(data)
         first = None
         resumed = False
+        self._batch_offset = 0  # advanced by anomaly rollbacks (skip window)
         if state is None:
             with meter.measure("input_stall"):
-                first = data.batch(0) if indexed else next(data_iter)
+                try:
+                    first = data.batch(0) if indexed else next(data_iter)
+                except StopIteration:
+                    raise ValueError("data is empty: the iterator yielded "
+                                     "no batches") from None
             rng = rng if rng is not None else jax.random.key(0)
             # init = trace + compile + (maybe) checkpoint restore; the
             # restore I/O is tiny next to the jit work, so one bucket
@@ -187,8 +211,15 @@ class Trainer:
                     self.ad, self.ckpt, rng, first
                 )
             start = int(state.step)
-            if resumed and jax.process_index() == 0:
-                print(f"resumed from step {start}")
+            if resumed:
+                # a prior run's anomaly rollback shifted the batch
+                # schedule; resume must replay the same shift or the
+                # trajectories diverge (saved by _ckpt_config)
+                saved_cfg = self.ckpt.restore_config(start)
+                if saved_cfg and saved_cfg.get("_batch_offset"):
+                    self._batch_offset = int(saved_cfg["_batch_offset"])
+                if jax.process_index() == 0:
+                    print(f"resumed from step {start}")
         else:
             start = int(state.step)
         plan = self.ad.plan
@@ -207,22 +238,35 @@ class Trainer:
         # step includes jit compilation (minutes for big models), which a
         # steady-state timeout would misreport as a stall.
         watchdog: StepWatchdog | None = None
+        on_stall = (self._stall_escalator() if cfg.watchdog_escalate
+                    else None)
+        guard = AnomalyGuard(cfg.anomaly) if cfg.anomaly else None
         heartbeat = (Heartbeat(cfg.heartbeat_dir).start()
                      if cfg.heartbeat_dir else None)
         self.preempt = (PreemptionGuard().install()
                         if cfg.preempt_drain else None)
+        exhausted = False
         try:
             if self.metrics:
                 self.metrics.start_step()
             if start < cfg.steps:
-                if not indexed:
-                    batch = first if first is not None else next(data_iter)
-                elif start == 0 and first is not None:
-                    batch = first
-                else:
-                    batch = data.batch(start)
+                try:
+                    if not indexed:
+                        batch = (first if first is not None
+                                 else next(data_iter))
+                    elif start == 0 and first is not None:
+                        # _batch_offset is necessarily 0 here (a shifted
+                        # resume has start > 0), so first == batch(0)
+                        batch = first
+                    else:
+                        batch = data.batch(start + self._batch_offset)
+                except StopIteration:
+                    obs_journal.event("data_exhausted", step=start,
+                                      saved=False)
+                    return state
             pending_metrics = None
-            for i in range(start, cfg.steps):
+            i = start
+            while i < cfg.steps:
                 t0 = time.perf_counter()
                 n_before = self.ad.n_compiles + self.ad.recompile_count
                 state, step_metrics = self.ad.step(state, batch)
@@ -233,10 +277,24 @@ class Trainer:
                            > n_before)
                 meter.add("compile" if tripped else "step", dur)
                 last_done = i + 1
+                if guard is not None:
+                    rolled = self._maybe_rollback(guard, state, step_metrics,
+                                                  i, indexed)
+                    if rolled is not None:
+                        state, i = rolled
+                        last_done = i
+                        batch = data.batch(i + self._batch_offset)
+                        continue
                 if i + 1 < cfg.steps:
-                    with meter.measure("input_stall"):
-                        batch = (data.batch(i + 1) if indexed
-                                 else next(data_iter))
+                    try:
+                        with meter.measure("input_stall"):
+                            batch = (data.batch(i + 1 + self._batch_offset)
+                                     if indexed else next(data_iter))
+                    except StopIteration:
+                        # plain iterator ran dry mid-run: finish this
+                        # step's bookkeeping, then save + return cleanly
+                        # at the bottom of the loop body
+                        exhausted = True
                 if cfg.watchdog_timeout_s:
                     # Beat on step *completion*, not dispatch — a hung
                     # collective must stop the beats (elastic.py).  Block
@@ -248,7 +306,7 @@ class Trainer:
                             jax.block_until_ready(pending_metrics)
                         if watchdog is None:
                             watchdog = StepWatchdog(
-                                cfg.watchdog_timeout_s
+                                cfg.watchdog_timeout_s, on_stall=on_stall
                             ).start()
                         watchdog.beat()
                     pending_metrics = step_metrics
@@ -284,7 +342,8 @@ class Trainer:
                     and (i + 1) % cfg.ckpt_every == 0
                 ):
                     with meter.measure("checkpoint"):
-                        self.ckpt.save(i + 1, state, config=self.run_config)
+                        self.ckpt.save(i + 1, state,
+                                       config=self._ckpt_config())
                     slow_block = True
                 for cb in self.callbacks:
                     cb(i + 1, state, step_metrics)
@@ -300,7 +359,7 @@ class Trainer:
                         with meter.measure("checkpoint"):
                             if self.ckpt.latest_step() != i + 1:
                                 self.ckpt.save(i + 1, state,
-                                               config=self.run_config,
+                                               config=self._ckpt_config(),
                                                force=True)
                             self.ckpt.wait()
                     if jax.process_index() == 0:
@@ -313,6 +372,22 @@ class Trainer:
                     # eval/checkpoint wall time must not bleed into the
                     # next training record's step_time/MFU
                     self.metrics.start_step()
+                if exhausted:
+                    obs_journal.event("data_exhausted", step=i + 1,
+                                      saved=bool(self.ckpt))
+                    if self.ckpt:
+                        with meter.measure("checkpoint"):
+                            if self.ckpt.latest_step() != i + 1:
+                                self.ckpt.save(i + 1, state,
+                                               config=self._ckpt_config(),
+                                               force=True)
+                            self.ckpt.wait()
+                    if jax.process_index() == 0:
+                        print(f"data exhausted after step {i + 1}"
+                              + (", checkpoint saved" if self.ckpt
+                                 else " (no checkpoint manager)"))
+                    return state
+                i += 1
             if cfg.watchdog_timeout_s and pending_metrics is not None:
                 # flush the lag-one beat: the final step (the only step,
                 # when resuming one short of cfg.steps) must arm/beat the
@@ -320,13 +395,15 @@ class Trainer:
                 with meter.measure("step"):
                     jax.block_until_ready(pending_metrics)
                 if watchdog is None:
-                    watchdog = StepWatchdog(cfg.watchdog_timeout_s).start()
+                    watchdog = StepWatchdog(cfg.watchdog_timeout_s,
+                                            on_stall=on_stall).start()
                 watchdog.beat()
             if self.ckpt and cfg.ckpt_every:
                 with meter.measure("checkpoint"):
                     if self.ckpt.latest_step() != cfg.steps:
                         self.ckpt.save(cfg.steps, state,
-                                       config=self.run_config, force=True)
+                                       config=self._ckpt_config(),
+                                       force=True)
                     self.ckpt.wait()
         finally:
             if watchdog:
@@ -349,6 +426,110 @@ class Trainer:
                 recompiles=self.ad.recompile_count,
             )
         return state
+
+    def _ckpt_config(self) -> dict | None:
+        """run_config to store with a checkpoint; carries the anomaly
+        rollback's batch-offset so a resumed run replays the same
+        (shifted) batch schedule."""
+        if not self._batch_offset:
+            return self.run_config
+        return {**(self.run_config or {}),
+                "_batch_offset": self._batch_offset}
+
+    def _stall_escalator(self):
+        """on_stall callback that raises StallError *in the training
+        thread*: the loop is blocked inside a hung dispatch, so the
+        watchdog thread plants an async exception that surfaces at the
+        next bytecode boundary and feeds run_with_recovery's retriable
+        path (elastic.py)."""
+        import ctypes
+
+        import threading
+
+        tid = threading.get_ident()  # the thread running fit()
+
+        def escalate(age_s: float) -> None:
+            obs_journal.event("resilience.stall_escalation", age_s=age_s,
+                              timeout_s=self.cfg.watchdog_timeout_s)
+            print(
+                f"[tadnn watchdog] escalating stall ({age_s:.1f}s) to "
+                f"StallError in the training thread",
+                file=sys.stderr, flush=True,
+            )
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(tid), ctypes.py_object(StallError)
+            )
+
+        return escalate
+
+    def _maybe_rollback(
+        self, guard: AnomalyGuard, state: "TrainState",
+        step_metrics: dict, i: int, indexed: bool,
+    ) -> "tuple[TrainState, int] | None":
+        """Anomaly check for the step just taken; on anomaly, restore
+        the last verified checkpoint and shift the batch schedule past
+        the offending window.  Returns (restored_state, resume_i) to
+        roll back, None to continue.  Raises when rollback is
+        impossible (no checkpoint / plain iterator / budget spent) —
+        the legacy nan-guard crash semantics."""
+        loss = step_metrics.get("loss")
+        if loss is None:
+            return None
+        reason = guard.check(float(loss))  # device sync, documented
+        if reason is None:
+            return None
+        anomaly_step = i + 1  # the step the bad batch produced
+        can = self.ckpt is not None and indexed
+        if can:
+            guard.rollbacks += 1
+        if not can or guard.rollbacks > self.cfg.anomaly.max_rollbacks:
+            raise FloatingPointError(
+                f"loss anomaly ({reason}) at step {anomaly_step} and "
+                + ("rollback budget exhausted "
+                   f"({self.cfg.anomaly.max_rollbacks})" if can else
+                   "no rollback path (needs a CheckpointManager and "
+                   "step-indexed data)")
+            )
+        self.ckpt.wait()  # in-flight saves must commit before we walk
+        restored, r = self._restore_last_verified(state)
+        if restored is None:
+            raise FloatingPointError(
+                f"loss anomaly ({reason}) at step {anomaly_step} and no "
+                "intact checkpoint to roll back to"
+            )
+        skipped = anomaly_step - r
+        self._batch_offset += skipped
+        obs_journal.event(
+            "resilience.rollback", reason=reason, loss=float(loss),
+            at_step=anomaly_step, to_step=r, skipped_batches=skipped,
+            batch_offset=self._batch_offset, rollback=guard.rollbacks,
+        )
+        if jax.process_index() == 0:
+            print(f"[tadnn] loss anomaly ({reason}) at step "
+                  f"{anomaly_step}: rolled back to step {r}, skipping "
+                  f"{skipped} batch(es)", file=sys.stderr, flush=True)
+        return restored, r
+
+    def _restore_last_verified(
+        self, state: "TrainState",
+    ) -> "tuple[TrainState | None, int | None]":
+        """Walk the fallback chain newest→oldest with verification,
+        quarantining corrupt steps (restore_or_init's walk, but against
+        the live state's shapes/shardings — no re-planning)."""
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                           sharding=x.sharding),
+            state,
+        )
+        while True:
+            step = self.ckpt.latest_step()
+            if step is None:
+                return None, None
+            try:
+                return self.ckpt.restore(abstract, step=step), step
+            except (CheckpointCorruptError, *RESTORE_ERRORS) as e:
+                self.ckpt.quarantine(step,
+                                     reason=f"{type(e).__name__}: {e}")
 
     def _drain_agreed(self, step: int) -> bool:
         """Cross-host agreement on the preemption drain.
