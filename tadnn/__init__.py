@@ -11,7 +11,8 @@ import torch_automatic_distributed_neural_network_tpu as _pkg
 # Make both `import tadnn.models` and `tadnn.models.X` resolve to the real
 # subpackages: register the sys.modules alias AND bind the attribute.
 _self = _sys.modules[__name__]
-for _name in ("models", "ops", "parallel", "utils", "data", "training"):
+for _name in ("models", "ops", "parallel", "utils", "data", "training",
+              "obs", "tune", "analysis"):
     _mod = _importlib.import_module(_pkg.__name__ + "." + _name)
     _sys.modules.setdefault(__name__ + "." + _name, _mod)
     setattr(_self, _name, _mod)

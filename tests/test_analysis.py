@@ -1,0 +1,565 @@
+"""Static analyzer (analysis/) tests: seeded violations per layer,
+`tadnn check` exit codes, and the Trainer preflight hookup.
+
+Plan-lint tests run on plain degree mappings (no devices); graph-lint
+tests trace on the 8 simulated CPU devices from conftest.py.
+"""
+
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import torch_automatic_distributed_neural_network_tpu as tad
+from torch_automatic_distributed_neural_network_tpu import (
+    analysis,
+    cli,
+    planner,
+    topology,
+)
+from torch_automatic_distributed_neural_network_tpu.analysis import (
+    graph_lint,
+    plan_lint,
+    source_lint,
+)
+from torch_automatic_distributed_neural_network_tpu.models import MLP
+from torch_automatic_distributed_neural_network_tpu.obs import Journal
+from torch_automatic_distributed_neural_network_tpu.obs import (
+    journal as obs_journal,
+)
+from torch_automatic_distributed_neural_network_tpu.training import (
+    Trainer,
+    TrainerConfig,
+    softmax_xent_loss,
+)
+
+
+def codes(findings):
+    return [f.code for f in findings]
+
+
+def sds(*shape, dtype=jnp.float32):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+# ---------------------------------------------------------------------------
+# plan lint (pure, no devices)
+# ---------------------------------------------------------------------------
+
+
+class TestPlanLint:
+    DEGREES = {"data": 1, "fsdp": 8, "tensor": 1}
+
+    def test_non_divisible_axis_is_pl001(self):
+        fs = plan_lint.lint_specs(
+            {"w": P("fsdp", None)}, P("fsdp"), self.DEGREES, "fsdp",
+            {"w": sds(12, 4)},
+        )
+        assert "PL001" in codes(fs)
+        (f,) = [f for f in fs if f.code == "PL001"]
+        assert f.severity == analysis.ERROR and "w" in f.where
+
+    def test_spec_with_more_dims_than_param_is_pl001(self):
+        fs = plan_lint.lint_specs(
+            {"b": P(None, "fsdp")}, P("fsdp"), self.DEGREES, "fsdp",
+            {"b": sds(16)},
+        )
+        assert "PL001" in codes(fs)
+
+    def test_duplicate_axis_is_pl002(self):
+        fs = plan_lint.lint_specs(
+            {"w": P("fsdp", "fsdp")}, P("fsdp"), self.DEGREES, "fsdp",
+            {"w": sds(16, 8)},
+        )
+        assert "PL002" in codes(fs)
+
+    def test_unknown_axis_is_pl003(self):
+        fs = plan_lint.lint_specs(
+            {"w": P("tensor", None)}, P("data"), {"data": 8}, "tp",
+        )
+        assert "PL003" in codes(fs)
+
+    def test_dead_mesh_axis_is_pl004(self):
+        fs = plan_lint.lint_specs(
+            {"w": P(None, None)}, P("data"),
+            {"data": 4, "tensor": 2}, "dp",
+        )
+        assert codes(fs) == ["PL004"]
+        assert "tensor" in fs[0].where
+
+    def test_seq_axis_is_not_dead(self):
+        # context parallelism shards activations, not params/batch
+        fs = plan_lint.lint_specs(
+            {"w": P(None)}, P("data"), {"data": 4, "seq": 2}, "dp",
+        )
+        assert "PL004" not in codes(fs)
+
+    def test_big_replicated_leaf_is_pl005(self):
+        fs = plan_lint.lint_specs(
+            {"emb": P(None, None), "w": P("fsdp", None)}, P("fsdp"),
+            self.DEGREES, "fsdp",
+            {"emb": sds(512, 128), "w": sds(16, 4)},
+            big_leaf_bytes=1024,
+        )
+        pl005 = [f for f in fs if f.code == "PL005"]
+        assert len(pl005) == 1 and "emb" in pl005[0].where
+        assert pl005[0].severity == analysis.WARN
+
+    def test_dp_never_warns_big_replicated(self):
+        fs = plan_lint.lint_specs(
+            {"emb": P(None, None)}, P("data"), {"data": 8}, "dp",
+            {"emb": sds(512, 128)}, big_leaf_bytes=1024,
+        )
+        assert "PL005" not in codes(fs)
+
+    def test_planner_output_is_clean(self):
+        abstract = {
+            "dense": {"kernel": sds(64, 32), "bias": sds(32)},
+            "out": {"kernel": sds(32, 16), "bias": sds(16)},
+        }
+        plan = planner.make_plan(
+            abstract, mesh=topology.build_mesh(fsdp=8), strategy="fsdp")
+        assert plan_lint.lint_plan(plan, abstract) == []
+
+
+# ---------------------------------------------------------------------------
+# graph lint
+# ---------------------------------------------------------------------------
+
+
+class TestGraphLint:
+    def test_hidden_all_gather_is_gl002(self, devices8):
+        """The acceptance case: an explicit all-gather over the data
+        axis that the dp plan's analytic comms model does not predict."""
+        from jax.experimental.shard_map import shard_map
+
+        mesh = topology.build_mesh(data=8)
+        abstract = {"w": sds(16, 4)}
+        plan = planner.make_plan(abstract, mesh=mesh, strategy="dp")
+
+        def step(x):
+            def inner(x):
+                return jax.lax.all_gather(x, "data")
+
+            return shard_map(inner, mesh=mesh, in_specs=P("data"),
+                             out_specs=P(None, "data"))(x)
+
+        closed = graph_lint.trace_step(step, sds(16, 4))
+        fs, cross = graph_lint.lint_collectives(closed, plan, abstract)
+        assert codes(fs) == ["GL002"]
+        assert "all_gather" in fs[0].msg and "'data'" in fs[0].msg
+        assert cross["unpredicted"][0]["prim"] == "all_gather"
+        # the same collective over the tensor axis of a tp plan is the
+        # planner's own megatron pattern -> not flagged
+        mesh_tp = topology.build_mesh(data=2, tensor=4)
+        abstract_tp = {"q_proj": {"kernel": sds(16, 8)}}
+        plan_tp = planner.make_plan(
+            abstract_tp, mesh=mesh_tp, strategy="tp")
+
+        def step_tp(x):
+            def inner(x):
+                return jax.lax.psum(x, "tensor")
+
+            return shard_map(
+                inner, mesh=mesh_tp,
+                in_specs=P(None, "tensor"), out_specs=P(None, "tensor"),
+            )(x)
+
+        closed_tp = graph_lint.trace_step(step_tp, sds(4, 8))
+        fs_tp, _ = graph_lint.lint_collectives(
+            closed_tp, plan_tp, abstract_tp)
+        assert fs_tp == []
+
+    def test_collective_inventory_counts_and_bytes(self, devices8):
+        from jax.experimental.shard_map import shard_map
+
+        mesh = topology.build_mesh(data=8)
+
+        def step(x):
+            def inner(x):
+                y = jax.lax.all_gather(x, "data")
+                return jax.lax.psum(x, "data"), y
+
+            return shard_map(inner, mesh=mesh, in_specs=P("data"),
+                             out_specs=(P(), P(None, "data")))(x)
+
+        inv = graph_lint.collective_inventory(
+            graph_lint.trace_step(step, sds(16, 4)))
+        by_kind = {r["kind"]: r for r in inv}
+        assert by_kind["gather"]["count"] == 1
+        assert by_kind["gather"]["bytes"] > 0
+        # psum's primitive name is version-dependent (psum/psum2)
+        assert by_kind["reduce"]["axes"] == ("data",)
+
+    def test_debug_print_is_gl001(self):
+        def step(x):
+            jax.debug.print("loss={x}", x=x.sum())
+            return x * 2
+
+        fs = graph_lint.lint_hazards(graph_lint.trace_step(step, sds(4)))
+        assert "GL001" in codes(fs)
+
+    def test_weak_typed_capture_is_gl003(self):
+        scale = jnp.asarray(2.0)  # weak-typed closure capture
+
+        def step(x):
+            return x * scale
+
+        fs = graph_lint.lint_hazards(graph_lint.trace_step(step, sds(4)))
+        assert codes(fs) == ["GL003"]
+        # a strongly-typed capture is deliberate -> silent
+        strong = jnp.asarray(2.0, dtype=jnp.float32)
+
+        def step2(x):
+            return x * strong
+
+        assert graph_lint.lint_hazards(
+            graph_lint.trace_step(step2, sds(4))) == []
+
+    def test_unhashable_static_arg_is_gl004(self):
+        fs = graph_lint.lint_static_args(
+            {"cfg": {"lr": 0.1}, "n": 4, "dims": (1, 2)})
+        assert codes(fs) == ["GL004"]
+        assert fs[0].severity == analysis.ERROR and "cfg" in fs[0].where
+
+
+# ---------------------------------------------------------------------------
+# source lint
+# ---------------------------------------------------------------------------
+
+
+def _lint(src):
+    return source_lint.lint_source(textwrap.dedent(src), "fixture.py")
+
+
+class TestSourceLint:
+    def test_duplicate_def_is_sl001(self):
+        fs = _lint("""
+            def f():
+                return 1
+
+            def f():
+                return 2
+        """)
+        assert codes(fs) == ["SL001"]
+
+    def test_conditional_redefinition_is_not_sl001(self):
+        fs = _lint("""
+            try:
+                from fast import f
+            except ImportError:
+                def f():
+                    return 1
+        """)
+        assert fs == []
+
+    def test_bare_except_is_sl002(self):
+        fs = _lint("""
+            def f():
+                try:
+                    g()
+                except:
+                    pass
+        """)
+        assert codes(fs) == ["SL002"]
+
+    def test_mutable_default_is_sl003(self):
+        fs = _lint("def f(xs=[]):\n    return xs\n")
+        assert codes(fs) == ["SL003"]
+        fs = _lint("def f(xs=dict()):\n    return xs\n")
+        assert codes(fs) == ["SL003"]
+
+    def test_call_in_default_is_sl006(self):
+        fs = _lint("""
+            def f(cfg=Config()):
+                return cfg
+        """)
+        assert codes(fs) == ["SL006"]
+        assert fs[0].severity == analysis.WARN
+
+    def test_dataclass_field_default_is_fine(self):
+        fs = _lint("""
+            import dataclasses
+
+            @dataclasses.dataclass
+            class C:
+                xs: list = dataclasses.field(default_factory=list)
+        """)
+        assert fs == []
+
+    def test_traced_branch_in_jitted_fn_is_sl004(self):
+        fs = _lint("""
+            import jax
+
+            @jax.jit
+            def step(x):
+                if x > 0:
+                    return x
+                return -x
+        """)
+        assert codes(fs) == ["SL004"]
+
+    def test_is_none_check_is_not_sl004(self):
+        fs = _lint("""
+            import jax
+
+            @jax.jit
+            def step(x, mask):
+                if mask is None:
+                    return x
+                return x * mask
+        """)
+        assert fs == []
+
+    def test_static_args_are_not_traced(self):
+        fs = _lint("""
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, static_argnames=("training",))
+            def step(x, training):
+                if training:
+                    return x * 2
+                return x
+        """)
+        assert fs == []
+
+    def test_unjitted_helper_is_not_flagged(self):
+        # host-side code may branch on anything
+        fs = _lint("""
+            def log_step(loss):
+                if loss > 10:
+                    print("diverging")
+        """)
+        assert fs == []
+
+    def test_jit_by_reference_is_detected(self):
+        fs = _lint("""
+            import jax
+            import numpy as np
+
+            def step(x):
+                return x * np.random.rand()
+
+            step_fn = jax.jit(step)
+        """)
+        assert codes(fs) == ["SL005"]
+
+    def test_host_clock_in_jitted_fn_is_sl005(self):
+        fs = _lint("""
+            import jax
+            import time
+
+            @jax.jit
+            def step(x):
+                return x * time.time()
+        """)
+        assert codes(fs) == ["SL005"]
+
+    def test_suppression_needs_a_reason(self):
+        src = """
+            def f():
+                try:
+                    g()
+                except:  # tadnn: lint-ok(SL002) third-party raises BaseException
+                    pass
+        """
+        assert _lint(src) == []
+        bare = src.replace(" third-party raises BaseException", "")
+        assert codes(_lint(bare)) == ["SL002"]
+
+    def test_suppression_on_previous_line(self):
+        fs = _lint("""
+            def f():
+                try:
+                    g()
+                # tadnn: lint-ok(SL002) exercised by chaos harness
+                except:
+                    pass
+        """)
+        assert fs == []
+
+    def test_suppression_is_code_specific(self):
+        fs = _lint("""
+            def f(xs=[]):  # tadnn: lint-ok(SL002) wrong code
+                return xs
+        """)
+        assert codes(fs) == ["SL003"]
+
+    def test_repo_is_clean(self):
+        findings = source_lint.lint_paths()
+        assert findings == [], "\n".join(f.format() for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# check_spec + CLI exit codes
+# ---------------------------------------------------------------------------
+
+
+class TestCheckCli:
+    def test_clean_repo_strict_exits_0(self, capsys):
+        assert cli.main(["check", "--strict"]) == 0
+        assert "0 error(s)" in capsys.readouterr().out
+
+    def test_seeded_source_violation_exits_1(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text("def f():\n    pass\n\ndef f():\n    pass\n")
+        assert cli.main(["check", str(bad)]) == 1
+        assert "SL001" in capsys.readouterr().out
+
+    def test_seeded_plan_violation_exits_1(self, tmp_path, capsys):
+        spec = tmp_path / "plan_spec.py"
+        spec.write_text(textwrap.dedent("""
+            import jax
+            from jax.sharding import PartitionSpec as P
+
+            def tadnn_check():
+                return {
+                    "param_specs": {"w": P("fsdp", None)},
+                    "batch_spec": P("fsdp"),
+                    "degrees": {"fsdp": 8},
+                    "strategy": "fsdp",
+                    "abstract_params": {
+                        "w": jax.ShapeDtypeStruct((12, 4), "float32"),
+                    },
+                }
+        """))
+        assert cli.main(
+            ["check", "--no-source", "--preflight", str(spec)]) == 1
+        assert "PL001" in capsys.readouterr().out
+
+    def test_seeded_graph_violation_strict_exits_1(
+            self, tmp_path, capsys, devices8):
+        spec = tmp_path / "graph_spec.py"
+        spec.write_text(textwrap.dedent("""
+            import jax
+            from jax.sharding import PartitionSpec as P
+            from jax.experimental.shard_map import shard_map
+            from torch_automatic_distributed_neural_network_tpu import (
+                planner, topology)
+
+            def tadnn_check():
+                mesh = topology.build_mesh(data=8)
+                abstract = {"w": jax.ShapeDtypeStruct((16, 4), "float32")}
+                plan = planner.make_plan(abstract, mesh=mesh, strategy="dp")
+
+                def step(x):
+                    def inner(x):
+                        return jax.lax.all_gather(x, "data")
+                    return shard_map(inner, mesh=mesh, in_specs=P("data"),
+                                     out_specs=P(None, "data"))(x)
+
+                return {
+                    "plan": plan,
+                    "abstract_params": abstract,
+                    "fn": step,
+                    "args": (jax.ShapeDtypeStruct((16, 4), "float32"),),
+                }
+        """))
+        # GL002 is warn-severity: plain check passes, --strict fails
+        assert cli.main(
+            ["check", "--no-source", "--preflight", str(spec)]) == 0
+        capsys.readouterr()
+        assert cli.main(
+            ["check", "--no-source", "--strict", "--preflight", str(spec)],
+        ) == 1
+        assert "GL002" in capsys.readouterr().out
+
+    def test_json_output(self, tmp_path, capsys):
+        import json as _json
+
+        bad = tmp_path / "bad.py"
+        bad.write_text("def f(xs=[]):\n    return xs\n")
+        assert cli.main(["check", "--json", str(bad)]) == 1
+        out = _json.loads(capsys.readouterr().out)
+        assert out["summary"]["errors"] == 1
+        assert out["findings"][0]["code"] == "SL003"
+
+    def test_exit_code_logic(self):
+        warn = analysis.Finding("GL002", analysis.WARN, "graph", "x", "m")
+        err = analysis.Finding("PL001", analysis.ERROR, "plan", "x", "m")
+        assert analysis.exit_code([]) == 0
+        assert analysis.exit_code([warn]) == 0
+        assert analysis.exit_code([warn], strict=True) == 1
+        assert analysis.exit_code([err]) == 1
+
+
+# ---------------------------------------------------------------------------
+# Trainer preflight
+# ---------------------------------------------------------------------------
+
+
+def _toy_batch(seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "x": jnp.asarray(rng.randn(16, 8), jnp.float32),
+        "label": jnp.asarray(rng.randint(0, 10, size=(16,))),
+    }
+
+
+class TestTrainerPreflight:
+    def _fit(self, cfg, journal):
+        ad = tad.AutoDistribute(
+            MLP(features=(16, 10)), optimizer=optax.sgd(0.1),
+            loss_fn=softmax_xent_loss, strategy="fsdp")
+        data = (_toy_batch(i) for i in range(cfg.steps))
+        Trainer(ad, cfg, journal=journal).fit(data)
+        return journal
+
+    def test_preflight_journals_lint_events(self, devices8):
+        j = self._fit(TrainerConfig(steps=2, preflight=True), Journal())
+        summaries = [r for r in j.named("lint.summary")]
+        assert summaries and summaries[0]["phase"] == "preflight"
+        assert summaries[0]["errors"] == 0
+
+    def test_preflight_off_is_silent(self, devices8):
+        j = self._fit(TrainerConfig(steps=2, preflight=False), Journal())
+        assert j.named("lint") == []
+
+    def test_preflight_raise_action(self, devices8, monkeypatch):
+        bad = analysis.Finding(
+            "PL001", analysis.ERROR, "plan", "w", "seeded")
+        monkeypatch.setattr(analysis, "preflight",
+                            lambda ad, batch, rng=None: [bad])
+        with pytest.raises(analysis.PreflightError) as ei:
+            self._fit(TrainerConfig(steps=2, preflight=True,
+                                    preflight_action="raise"), Journal())
+        assert "PL001" in str(ei.value)
+
+    def test_analyzer_crash_never_blocks_training(self, devices8,
+                                                  monkeypatch):
+        def boom(ad, batch, rng=None):
+            raise RuntimeError("analyzer bug")
+
+        monkeypatch.setattr(analysis, "preflight", boom)
+        j = self._fit(TrainerConfig(steps=2, preflight=True), Journal())
+        skipped = j.named("lint.skipped")
+        assert skipped and "analyzer bug" in skipped[0]["error"]
+
+    def test_preflight_report_rendering(self, tmp_path, devices8):
+        jpath = tmp_path / "journal.jsonl"
+        ad = tad.AutoDistribute(
+            MLP(features=(16, 10)), optimizer=optax.sgd(0.1),
+            loss_fn=softmax_xent_loss, strategy="fsdp")
+        with Journal(str(jpath)) as j:
+            with obs_journal.as_default(j):
+                state = ad.init(jax.random.key(0), _toy_batch())
+                analysis.journal_findings(
+                    [analysis.Finding("GL002", analysis.WARN, "graph",
+                                      "<all_gather over data>", "seeded")],
+                    phase="preflight",
+                )
+        from torch_automatic_distributed_neural_network_tpu.obs import (
+            report as obs_report,
+        )
+
+        rep = obs_report.generate(str(jpath))
+        assert rep["lint"]["warnings"] == 1
+        assert rep["lint"]["findings"][0]["code"] == "GL002"
+        text = obs_report.format_report(rep)
+        assert "lint (preflight): 0 error(s), 1 warning(s)" in text
+        assert "GL002" in text

@@ -3,43 +3,25 @@
 Round-4 advisor finding: ``parallel/pipeline.py`` carried ~240 lines of
 dead code because a bad merge left two top-level ``def`` statements with
 the same name — Python's last-def-wins made it invisible at runtime.
-This scan fails loudly if any module in the package (or this test tree)
-defines the same top-level name twice.
+
+The scan itself now lives in ``analysis.source_lint`` as rule SL001
+(so ``tadnn check`` and this test cannot drift); this test keeps its
+name and tier-1 seat and asserts the rule holds over the same file set
+it has always guarded (the package, tests, and top-level scripts —
+``source_lint.default_paths``).
 """
 
-import ast
-import pathlib
-
-import torch_automatic_distributed_neural_network_tpu as tad
-
-PKG_ROOT = pathlib.Path(tad.__file__).parent
-REPO_ROOT = PKG_ROOT.parent
-
-
-def _duplicate_toplevel_names(path: pathlib.Path) -> list[str]:
-    tree = ast.parse(path.read_text())
-    seen: dict[str, int] = {}
-    dups = []
-    for node in tree.body:
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.ClassDef)):
-            if node.name in seen:
-                dups.append(
-                    f"{path.relative_to(REPO_ROOT)}:{node.lineno} "
-                    f"shadows {node.name!r} first defined at line "
-                    f"{seen[node.name]}"
-                )
-            else:
-                seen[node.name] = node.lineno
-    return dups
+from torch_automatic_distributed_neural_network_tpu.analysis import (
+    source_lint,
+)
 
 
 def test_no_shadowed_toplevel_defs():
-    files = sorted(PKG_ROOT.rglob("*.py"))
-    files += sorted((REPO_ROOT / "tests").glob("*.py"))
-    for extra in ("bench.py", "__graft_entry__.py", "tpu_probe.py"):
-        if (REPO_ROOT / extra).exists():
-            files.append(REPO_ROOT / extra)
-    assert files, "package sources not found"
-    problems = [d for f in files for d in _duplicate_toplevel_names(f)]
+    paths = source_lint.default_paths()
+    assert paths, "package sources not found"
+    problems = [
+        f.format()
+        for f in source_lint.lint_paths(paths)
+        if f.code == "SL001"
+    ]
     assert not problems, "shadowed top-level defs:\n" + "\n".join(problems)

@@ -337,6 +337,50 @@ def cmd_doctor(args: argparse.Namespace) -> int:
     return 0 if report["healthy"] else 1
 
 
+def cmd_check(args: argparse.Namespace) -> int:
+    """Static analyzer (analysis/): source lint over the repo's Python
+    by default; ``--preflight FILE`` adds plan + graph lint driven by
+    the file's ``tadnn_check()`` dict.  Exit 1 on error-severity
+    findings; with ``--strict`` also on warnings."""
+    from . import analysis
+
+    if args.rules:
+        for r in analysis.RULES.values():
+            print(f"{r.code}  {r.layer:<6} {r.severity:<5} {r.title}")
+        return 0
+    findings: list = []
+    if not args.no_source:
+        from .analysis import source_lint
+
+        findings += source_lint.lint_paths(args.paths or None)
+    if args.preflight:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "_tadnn_check_target", args.preflight)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        hook = getattr(mod, "tadnn_check", None)
+        if hook is None:
+            print(f"{args.preflight} does not define tadnn_check()",
+                  file=sys.stderr)
+            return 2
+        findings += analysis.check_spec(hook())
+    analysis.journal_findings(findings, phase="check")
+    summary = analysis.summarize(findings)
+    if args.json:
+        print(json.dumps({
+            "findings": [f.to_json() for f in findings],
+            "summary": summary,
+        }))
+    else:
+        for f in findings:
+            print(f.format())
+        print(f"tadnn check: {summary['errors']} error(s), "
+              f"{summary['warnings']} warning(s)")
+    return analysis.exit_code(findings, strict=args.strict)
+
+
 def cmd_tokenize(args: argparse.Namespace) -> int:
     """Text -> TADN token file (data/text.py)."""
     from .data.text import load_tokenizer, tokenize_file
@@ -455,6 +499,29 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("directory", help="CheckpointManager directory")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_doctor)
+
+    p = sub.add_parser(
+        "check",
+        help="static analyzer: source lint over the repo (and plan/graph "
+             "lint with --preflight FILE); exit 1 on errors, with "
+             "--strict also on warnings",
+    )
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to source-lint (default: the "
+                        "package, tests, examples and top-level scripts)")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--strict", action="store_true",
+                   help="warnings also fail (exit 1)")
+    p.add_argument("--rules", action="store_true",
+                   help="print the rule table and exit")
+    p.add_argument("--preflight", default=None, metavar="FILE",
+                   help="python file defining tadnn_check() -> dict with "
+                        "keys among plan/abstract_params/param_specs/"
+                        "batch_spec/degrees/strategy/fn/args/static_args; "
+                        "runs plan + graph lint on it")
+    p.add_argument("--no-source", action="store_true",
+                   help="skip the source lint (only --preflight layers)")
+    p.set_defaults(fn=cmd_check)
 
     p = sub.add_parser(
         "tokenize",

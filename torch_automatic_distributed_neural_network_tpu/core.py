@@ -829,6 +829,9 @@ class AutoDistribute:
             metrics = {"loss": loss, **aux}
             return new_state, metrics
 
+        # the unjitted step: analysis.preflight re-traces it with
+        # jax.make_jaxpr (graph lint) without touching the jit cache
+        self._step_fn_raw = train_step
         self._step_fn = jax.jit(
             train_step,
             in_shardings=(shardings, batch_sharding),

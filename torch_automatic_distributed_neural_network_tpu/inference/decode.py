@@ -341,7 +341,7 @@ def generate(
     prompt: jax.Array,  # [B, P] int32
     *,
     max_new_tokens: int,
-    sample: SampleConfig = SampleConfig(temperature=0.0),
+    sample: SampleConfig | None = None,
     rng: jax.Array | None = None,
     cache_dtype=jnp.bfloat16,
     mesh=None,
@@ -362,6 +362,8 @@ def generate(
     is ``eos_id`` (the output stays fixed-shape — XLA needs static trip
     counts — but rows are individually final after their EOS).
     """
+    if sample is None:
+        sample = SampleConfig(temperature=0.0)
     cfg: TransformerConfig = model.cfg
     params = variables["params"]
     B, P = prompt.shape

@@ -117,6 +117,16 @@ class Journal:
             rec["dur_s"] = time.monotonic() - t_start
             self._write(rec)
 
+    def named(self, prefix: str) -> list[dict]:
+        """In-memory records (``path=None`` mode) whose name is
+        ``prefix`` or lives under it as a dotted namespace — ``'lint'``
+        matches ``lint.finding`` and ``lint.summary``."""
+        return [
+            rec for rec in self.records
+            if rec.get("name", "") == prefix
+            or rec.get("name", "").startswith(prefix + ".")
+        ]
+
     # -- lifecycle ----------------------------------------------------------
 
     def close(self) -> None:

@@ -201,6 +201,29 @@ def generate(target: str, metrics_path: str | None = None) -> dict:
         gave_up = [e for e in restarts if e.get("gave_up")]
         if restarts:
             report["incidents"]["restarts_gave_up"] = len(gave_up)
+    lint_findings = [e for e in events if e.get("name") == "lint.finding"]
+    lint_summary = last("lint.summary")
+    lint_skipped = last("lint.skipped")
+    if lint_findings or lint_summary or lint_skipped:
+        lint: dict[str, Any] = {
+            "errors": (lint_summary or {}).get("errors",
+                                               len([f for f in lint_findings
+                                                    if f.get("severity")
+                                                    == "error"])),
+            "warnings": (lint_summary or {}).get("warnings",
+                                                 len([f for f in lint_findings
+                                                      if f.get("severity")
+                                                      == "warn"])),
+            "by_code": (lint_summary or {}).get("by_code"),
+            "phase": (lint_summary or lint_skipped or {}).get("phase"),
+            "findings": [
+                {k: e.get(k) for k in ("code", "severity", "where", "msg")}
+                for e in lint_findings
+            ],
+        }
+        if lint_skipped:
+            lint["skipped"] = lint_skipped.get("error")
+        report["lint"] = {k: v for k, v in lint.items() if v is not None}
     if metrics_path and os.path.isfile(metrics_path):
         recs = _read_metrics(metrics_path)
         steps = [r for r in recs if "step_time_s" in r]
@@ -339,6 +362,21 @@ def format_report(report: dict) -> str:
                     f"  rollback ({d.get('reason')}): step "
                     f"{d.get('at_step')} -> {d.get('to_step')}, skipped "
                     f"{d.get('skipped_batches')} batch(es)")
+    lint = report.get("lint")
+    if lint:
+        head = (f"lint ({lint.get('phase', 'check')}): "
+                f"{lint.get('errors', 0)} error(s), "
+                f"{lint.get('warnings', 0)} warning(s)")
+        by_code = lint.get("by_code")
+        if by_code:
+            head += "  [" + "  ".join(
+                f"{c}×{n}" for c, n in sorted(by_code.items())) + "]"
+        lines.append(head)
+        for f in lint.get("findings", [])[-6:]:
+            lines.append(f"  {f.get('code')} {f.get('severity')} "
+                         f"{f.get('where')}: {f.get('msg')}")
+        if lint.get("skipped"):
+            lines.append(f"  preflight skipped: {lint['skipped']}")
     bi = report.get("bench_incidents")
     if bi:
         lines.append(f"bench incidents: {len(bi)}")

@@ -482,7 +482,7 @@ def choose_strategy(
     return "fsdp", {"fsdp": n}
 
 
-def _spec_axes(spec: P) -> set[str]:
+def spec_axes(spec: P) -> set[str]:
     """Mesh axis names a PartitionSpec actually uses."""
     out: set[str] = set()
     for entry in spec:
@@ -492,6 +492,10 @@ def _spec_axes(spec: P) -> set[str]:
             if ax:
                 out.add(ax)
     return out
+
+
+# pre-analysis/ name; tune/ and external callers may still use it
+_spec_axes = spec_axes
 
 
 def expected_collective_bytes(
@@ -550,7 +554,7 @@ def expected_collective_bytes(
         shape = tuple(getattr(leaf, "shape", ()))
         count = math.prod(shape) if shape else 1
         p_itemsize = np.dtype(getattr(leaf, "dtype", np.float32)).itemsize
-        axes_used = _spec_axes(spec)
+        axes_used = spec_axes(spec)
         # fraction of the param each device holds after non-batch-axis
         # sharding (tensor / pipe / expert)
         f_other = 1.0

@@ -77,6 +77,10 @@ class TrainerConfig:
     # large models) set this to 1-2.  Single-process runs check the
     # local flag every step regardless.
     preempt_check_every: int = 8
+    # static plan/graph lint (analysis.preflight) before step 0 —
+    # trace-only, no extra compile (BENCH_NOTES)
+    preflight: bool = True
+    preflight_action: str = "warn"  # 'warn' | 'raise'
 
 
 def _is_step_indexed(data: Any) -> bool:
@@ -92,7 +96,7 @@ class Trainer:
     def __init__(
         self,
         ad: "AutoDistribute",
-        cfg: TrainerConfig = TrainerConfig(),
+        cfg: "TrainerConfig | None" = None,
         *,
         metrics: MetricsLogger | None = None,
         ckpt: CheckpointManager | None = None,
@@ -103,7 +107,7 @@ class Trainer:
         journal: "Journal | None" = None,
     ):
         self.ad = ad
-        self.cfg = cfg
+        self.cfg = cfg if cfg is not None else TrainerConfig()
         self.metrics = metrics
         self.ckpt = ckpt
         self.items_per_step = items_per_step
@@ -182,6 +186,28 @@ class Trainer:
                     # logging, never crashes)
                     self.metrics.close()
 
+    def _preflight(self, batch: Any, rng: "jax.Array | None" = None) -> None:
+        """Static plan + graph lint against the built plan and a
+        re-trace of the step fn (``analysis.preflight``) — trace-only,
+        nothing is compiled or executed.  ``preflight_action='warn'``
+        prints findings and continues; ``'raise'`` escalates
+        error-severity findings to :class:`analysis.PreflightError`.
+        A crash in the analyzer itself never blocks training."""
+        from .. import analysis
+
+        try:
+            findings = analysis.preflight(self.ad, batch, rng=rng)
+        except Exception as e:
+            obs_journal.event("lint.skipped", phase="preflight",
+                              error=f"{type(e).__name__}: {e}")
+            return
+        if findings and jax.process_index() == 0:
+            for f in findings:
+                print(f"preflight: {f.format()}", file=sys.stderr)
+        if self.cfg.preflight_action == "raise" and any(
+                f.severity == analysis.ERROR for f in findings):
+            raise analysis.PreflightError(findings)
+
     def _fit(
         self,
         data: "Iterable[Any] | Any",
@@ -222,6 +248,17 @@ class Trainer:
                     print(f"resumed from step {start}")
         else:
             start = int(state.step)
+        if cfg.preflight:
+            pf_batch = first
+            if pf_batch is None and indexed:
+                try:
+                    pf_batch = data.batch(start + self._batch_offset)
+                except Exception:
+                    pf_batch = None
+            if pf_batch is not None:
+                # shares the compile bucket: trace-time work before step 0
+                with meter.measure("compile"):
+                    self._preflight(pf_batch, rng)
         plan = self.ad.plan
         obs_journal.event(
             "run_start", start_step=start, steps=cfg.steps, resumed=resumed,

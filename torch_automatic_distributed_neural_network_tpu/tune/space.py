@@ -157,7 +157,7 @@ def candidate_memory(
         itemsize = np.dtype(getattr(leaf, "dtype", np.float32)).itemsize
         nbytes = (math.prod(shape) if shape else 1) * itemsize
         frac = 1
-        for ax in planner._spec_axes(spec):
+        for ax in planner.spec_axes(spec):
             frac *= degrees.get(ax, 1)
         param_b += nbytes / max(1, frac)
     state_b = state_factor * param_b
