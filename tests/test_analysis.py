@@ -524,7 +524,7 @@ class TestTrainerPreflight:
         bad = analysis.Finding(
             "PL001", analysis.ERROR, "plan", "w", "seeded")
         monkeypatch.setattr(analysis, "preflight",
-                            lambda ad, batch, rng=None: [bad])
+                            lambda ad, batch, rng=None, **kw: [bad])
         with pytest.raises(analysis.PreflightError) as ei:
             self._fit(TrainerConfig(steps=2, preflight=True,
                                     preflight_action="raise"), Journal())
@@ -532,7 +532,7 @@ class TestTrainerPreflight:
 
     def test_analyzer_crash_never_blocks_training(self, devices8,
                                                   monkeypatch):
-        def boom(ad, batch, rng=None):
+        def boom(ad, batch, rng=None, **kw):
             raise RuntimeError("analyzer bug")
 
         monkeypatch.setattr(analysis, "preflight", boom)

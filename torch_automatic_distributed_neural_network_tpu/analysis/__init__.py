@@ -17,6 +17,14 @@ compile-time analyzability — see PAPERS.md):
   the package/tests/examples — duplicate top-level defs, traced-value
   branching in jitted helpers, host clock/RNG in jitted step functions,
   bare excepts, mutable defaults.
+- **memory lint** (:mod:`.mem_lint`): liveness intervals over the
+  traced step jaxpr → a per-device peak-HBM prediction under the plan's
+  sharding, checked against a ``ChipSpec`` budget (predicted OOM =
+  error); the same walk at global shapes feeds the tuner's memory
+  pruning.
+- **dtype lint** (:mod:`.dtype_lint`): abstract dtype propagation over
+  the same trace — loss-path downcasts, f16 overflow-prone sums,
+  weak types at collectives, mixed-dtype param trees.
 
 Findings are typed (``error``/``warn``), journaled as ``lint.*`` events,
 rendered by ``tadnn report``, runnable via ``tadnn check [--json]
@@ -62,6 +70,9 @@ class RuleInfo:
     layer: str
     severity: str
     title: str
+    # Byte threshold for size-gated rules (PL005) — the table is the one
+    # tunable default; CLI/API overrides shadow it per call.
+    threshold: int | None = None
 
 
 # The rule table rendered by ``tadnn check --rules`` and the README.
@@ -78,7 +89,7 @@ RULES: dict[str, RuleInfo] = {
                  "dead mesh axis: degree > 1 but no spec ever uses it"),
         RuleInfo("PL005", "plan", WARN,
                  "large param leaf fully replicated under a sharding "
-                 "strategy"),
+                 "strategy", threshold=64 * 2**20),
         RuleInfo("GL001", "graph", WARN,
                  "host side-effect (debug print / callback) inside the "
                  "jitted step"),
@@ -105,6 +116,26 @@ RULES: dict[str, RuleInfo] = {
         RuleInfo("SL006", "source", WARN,
                  "function call in a default argument (evaluated once at "
                  "def time)"),
+        RuleInfo("ML001", "mem", ERROR,
+                 "predicted per-device peak HBM exceeds the chip budget "
+                 "(would OOM)"),
+        RuleInfo("ML002", "mem", WARN,
+                 "predicted peak within the headroom margin of the HBM "
+                 "budget"),
+        RuleInfo("ML003", "mem", WARN,
+                 "activation-dominated peak with remat off (checkpointing "
+                 "would cut it)"),
+        RuleInfo("DT001", "dtype", WARN,
+                 "unintended f32→bf16/f16 downcast on the loss/optimizer "
+                 "path"),
+        RuleInfo("DT002", "dtype", WARN,
+                 "f16 overflow-prone accumulation (sums saturate at "
+                 "65504)"),
+        RuleInfo("DT003", "dtype", WARN,
+                 "weak-typed operand entering a collective (promotion "
+                 "surprise)"),
+        RuleInfo("DT004", "dtype", WARN,
+                 "param tree mixes float dtypes across leaves"),
     )
 }
 
@@ -144,6 +175,25 @@ def journal_findings(findings: Sequence[Finding], *,
     obs_journal.event("lint.summary", phase=phase, **summarize(findings))
 
 
+def filter_ignored(findings: Iterable[Finding],
+                   ignore: Iterable[str] = ()) -> list[Finding]:
+    """Drop findings whose code is in ``ignore`` — the plan/graph/mem/
+    dtype analog of source lint's ``# tadnn: lint-ok(CODE)`` comment
+    (those layers have no source line to hang a comment on).  Unknown
+    codes raise: a typo'd suppression that silently suppresses nothing
+    is worse than an error."""
+    codes = {str(c).strip().upper() for c in (ignore or ())
+             if str(c).strip()}
+    if not codes:
+        return list(findings)
+    unknown = sorted(codes - set(RULES))
+    if unknown:
+        raise ValueError(
+            f"unknown lint code(s) in ignore: {', '.join(unknown)} "
+            "(see `tadnn check --rules`)")
+    return [f for f in findings if f.code not in codes]
+
+
 def exit_code(findings: Sequence[Finding], *, strict: bool = False) -> int:
     """``tadnn check`` exit status: 1 on any error, with ``--strict``
     also on any warning."""
@@ -171,18 +221,27 @@ def _abstract_like(tree: Any) -> Any:
 
 
 def preflight(ad: Any, sample_batch: Any, *, rng: Any = None,
-              big_leaf_bytes: int | None = None) -> list[Finding]:
-    """Plan + graph lint for a built AutoDistribute — the Trainer's
-    before-step-0 hook.
+              big_leaf_bytes: int | None = None,
+              budget: int | str | None = None,
+              headroom: float | None = None,
+              ignore: Iterable[str] = ()) -> list[Finding]:
+    """Plan + graph + memory + dtype lint for a built AutoDistribute —
+    the Trainer's before-step-0 hook.
 
-    Trace-only and off the hot path: the graph layer re-traces the
-    (already compiled) train step to a jaxpr with ``jax.make_jaxpr``;
-    nothing is compiled or executed.  Findings are journaled as
-    ``lint.*`` events with ``phase='preflight'``.
+    Trace-only and off the hot path: the graph/mem/dtype layers
+    re-trace the (already compiled) train step to a jaxpr with
+    ``jax.make_jaxpr``; nothing is compiled or executed.  ``budget``
+    (bytes, or '16GiB') defaults to the detected chip's HBM — the
+    memory layer errors (ML001) when the predicted peak exceeds it,
+    which under ``preflight_action='raise'`` aborts before step 0
+    instead of OOMing at it.  ``ignore`` suppresses known-benign codes
+    (:func:`filter_ignored`).  Findings are journaled as ``lint.*``
+    events with ``phase='preflight'``, the breakdown as
+    ``lint.mem_estimate``.
     """
     import jax
 
-    from . import graph_lint, plan_lint
+    from . import dtype_lint, graph_lint, mem_lint, plan_lint
 
     if ad.plan is None:
         raise ValueError("preflight needs a built plan — call "
@@ -199,10 +258,37 @@ def preflight(ad: Any, sample_batch: Any, *, rng: Any = None,
         state_abs = jax.eval_shape(ad._make_state_fn(sample_batch), rng)
         batch_abs = _abstract_like(sample_batch)
         closed = graph_lint.trace_step(raw, state_abs, batch_abs)
+        grad_accum = getattr(ad, "_grad_accum", 1)
         findings += graph_lint.lint_graph(
             closed, plan=ad.plan, abstract_params=abstract,
-            grad_accum=getattr(ad, "_grad_accum", 1),
+            grad_accum=grad_accum,
         )
+        prec = getattr(ad, "precision", None)
+        findings += dtype_lint.lint_dtypes(
+            closed,
+            abstract_params=state_abs.params,
+            compute_dtype=getattr(prec, "compute_dtype", None),
+        )
+        try:
+            est = mem_lint.estimate_step_memory(
+                closed, ad.plan, state_abs.params,
+                opt_state=state_abs.opt_state,
+                model_state=state_abs.model_state,
+                batch=batch_abs, grad_accum=grad_accum,
+            )
+            budget_b = mem_lint.resolve_budget(budget)
+            hr = (mem_lint.DEFAULT_HEADROOM if headroom is None
+                  else float(headroom))
+            findings += mem_lint.lint_memory(
+                est, budget_bytes=budget_b, headroom=hr)
+            obs_journal.event(
+                "lint.mem_estimate", phase="preflight",
+                budget_bytes=budget_b, **est.to_json())
+        except Exception as e:  # the estimator must never block training
+            obs_journal.event(
+                "lint.skipped", phase="preflight", layer="mem",
+                error=f"{type(e).__name__}: {e}")
+    findings = filter_ignored(findings, ignore)
     journal_findings(findings, phase="preflight")
     return findings
 
@@ -219,11 +305,16 @@ def check_spec(spec: Mapping[str, Any]) -> list[Finding]:
     - ``abstract_params`` (pytree of shape/dtype leaves) enables the
       shape-dependent plan rules and the graph cross-check;
     - ``fn`` + ``args`` (callable and its example/abstract arguments)
-      → traced with ``jax.make_jaxpr`` and graph-linted;
+      → traced with ``jax.make_jaxpr``, graph- and dtype-linted;
     - ``static_args`` (name → value mapping) → hashability check;
-    - ``big_leaf_bytes`` / ``grad_accum`` tune the thresholds.
+    - ``budget`` (bytes or '16GiB'; needs ``plan`` + ``fn`` +
+      ``abstract_params``) → liveness memory lint against that HBM
+      budget, with optional ``opt_state`` / ``batch`` abstract trees
+      and ``headroom``;
+    - ``big_leaf_bytes`` / ``grad_accum`` / ``compute_dtype`` tune the
+      thresholds.
     """
-    from . import graph_lint, plan_lint
+    from . import dtype_lint, graph_lint, mem_lint, plan_lint
 
     findings: list[Finding] = []
     kwargs = {}
@@ -252,16 +343,111 @@ def check_spec(spec: Mapping[str, Any]) -> list[Finding]:
             grad_accum=int(spec.get("grad_accum", 1)),
             static_args=spec.get("static_args"),
         )
+        findings += dtype_lint.lint_dtypes(
+            closed,
+            abstract_params=spec.get("abstract_params"),
+            compute_dtype=spec.get("compute_dtype"),
+        )
+        if (spec.get("budget") is not None and plan is not None
+                and spec.get("abstract_params") is not None):
+            est = mem_lint.estimate_step_memory(
+                closed, plan, spec["abstract_params"],
+                opt_state=spec.get("opt_state"),
+                batch=spec.get("batch"),
+                grad_accum=int(spec.get("grad_accum", 1)),
+            )
+            findings += mem_lint.lint_memory(
+                est,
+                budget_bytes=mem_lint.resolve_budget(spec["budget"]),
+                headroom=float(
+                    spec.get("headroom", mem_lint.DEFAULT_HEADROOM)),
+            )
     elif spec.get("static_args"):
         findings += graph_lint.lint_static_args(spec["static_args"])
     return findings
 
 
+def analyze(spec: Mapping[str, Any], *,
+            ignore: Iterable[str] = ()) -> list[Finding]:
+    """:func:`check_spec` with suppression — the canonical programmatic
+    entry: ``analysis.analyze(spec, ignore=('PL005',))``."""
+    return filter_ignored(check_spec(spec), ignore)
+
+
+def memory_check(ad: Any, sample_batch: Any, *, rng: Any = None,
+                 budget: int | str | None = None,
+                 headroom: float | None = None,
+                 big_leaf_bytes: int | None = None,
+                 compiled: bool = True,
+                 ignore: Iterable[str] = ()) -> tuple[list[Finding], dict]:
+    """The ``tadnn check --memory`` driver: build/trace the step, run
+    plan + memory + dtype lint, and return ``(findings, report)`` where
+    ``report`` is the breakdown ``tadnn report`` renders.
+
+    With ``compiled`` (default), the static estimate is cross-checked
+    against XLA's ``compiled_cost`` peak (an AOT compile — the only
+    non-trace-only part; pass ``compiled=False`` to stay device-free).
+    The report is journaled as a ``lint.mem_estimate`` event; findings
+    are NOT journaled here (the caller aggregates layers first).
+    """
+    import jax
+
+    from . import dtype_lint, graph_lint, mem_lint, plan_lint
+
+    rng = rng if rng is not None else jax.random.key(0)
+    if ad.plan is None:
+        ad.build_plan(rng, sample_batch)
+    state_abs = jax.eval_shape(ad._make_state_fn(sample_batch), rng)
+    if getattr(ad, "_step_fn_raw", None) is None:
+        ad._compile_step(state_abs, ad.state_shardings(state_abs))
+    abstract = state_abs.params
+    batch_abs = _abstract_like(sample_batch)
+    closed = graph_lint.trace_step(ad._step_fn_raw, state_abs, batch_abs)
+    kwargs = {}
+    if big_leaf_bytes is not None:
+        kwargs["big_leaf_bytes"] = big_leaf_bytes
+    findings = plan_lint.lint_plan(ad.plan, abstract, **kwargs)
+    prec = getattr(ad, "precision", None)
+    findings += dtype_lint.lint_dtypes(
+        closed, abstract_params=abstract,
+        compute_dtype=getattr(prec, "compute_dtype", None))
+    grad_accum = getattr(ad, "_grad_accum", 1)
+    est = mem_lint.estimate_step_memory(
+        closed, ad.plan, abstract,
+        opt_state=state_abs.opt_state,
+        model_state=state_abs.model_state,
+        batch=batch_abs, grad_accum=grad_accum,
+    )
+    budget_b = mem_lint.resolve_budget(budget)
+    hr = mem_lint.DEFAULT_HEADROOM if headroom is None else float(headroom)
+    findings += mem_lint.lint_memory(est, budget_bytes=budget_b,
+                                     headroom=hr)
+    report = {**est.to_json(), "budget_bytes": int(budget_b),
+              "headroom": hr}
+    if compiled:
+        comp = ad.compile_report(rng, sample_batch) or {}
+        peak_c = comp.get("per_device_peak_bytes")
+        report["compiled"] = {
+            "per_device_peak_bytes": peak_c,
+            "bytes_accessed": comp.get("bytes_accessed"),
+            "error": comp.get("error"),
+        }
+        if peak_c:
+            report["static_over_compiled"] = round(
+                est.peak_bytes / peak_c, 3)
+    findings = filter_ignored(findings, ignore)
+    obs_journal.event("lint.mem_estimate", phase="check", **report)
+    return findings, report
+
+
 __all__ = [
     "ERROR",
     "WARN",
+    "analyze",
     "check_spec",
     "Finding",
+    "filter_ignored",
+    "memory_check",
     "PreflightError",
     "RULES",
     "RuleInfo",

@@ -1,0 +1,226 @@
+"""Dtype-flow lint: abstract dtype propagation over the step jaxpr (DT00x).
+
+The same trace-only walk as :mod:`graph_lint` (``jax.make_jaxpr``, no
+compile), but following *dtypes* instead of bytes.  Every jaxpr value
+carries an aval with a concrete dtype and a weak-type bit, so numerics
+hazards that only surface as slow divergence on a real run — a loss
+accumulated in bf16, an f16 sum that saturates at 65504, a weak-typed
+scalar silently setting the result dtype of a collective — are visible
+statically:
+
+- **DT001** unintended f32→bf16/f16 downcast on the loss/optimizer
+  path: a scalar downcast (the loss itself, an optimizer scale), or a
+  reduction output downcast that is not the configured mixed-precision
+  compute dtype.  Casting *inputs* down (the mixed-precision pattern)
+  is fine and not flagged; casting the *accumulated result* down
+  throws away exactly the bits the accumulation was widened for.
+- **DT002** f16 overflow-prone accumulation: reduce_sum / dot_general /
+  cumsum / conv accumulating **in** float16 — partial sums overflow at
+  65504 even when every element is small.  bf16 shares f32's exponent
+  range and is exempt.
+- **DT003** weak-typed operand entering a collective: promotion
+  semantics differ per backend/jax version at the collective boundary,
+  so the result dtype depends on a Python literal nobody sees.
+- **DT004** mixed float dtypes across param leaves: grads and optimizer
+  moments inherit per-leaf dtypes, so updates promote inconsistently
+  (tree-level check, no trace needed).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from . import WARN, Finding
+from .graph_lint import COLLECTIVE_KINDS, _jaxpr_of
+
+_LOW_FLOATS = frozenset({"bfloat16", "float16"})
+
+# Primitives whose output is an accumulated value: downcasting it
+# discards the accumulation's extra precision (DT001 reduced-path).
+_REDUCTIONS = frozenset({
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+    "cumsum", "cumlogsumexp", "dot_general", "conv_general_dilated",
+})
+
+# Sum-accumulating primitives where f16 partials can exceed 65504
+# (DT002).  Max/min never grow, so they are not listed.
+_SUM_PRIMS = frozenset({
+    "reduce_sum", "cumsum", "dot_general", "conv_general_dilated",
+})
+
+
+def _dtype_name(aval: Any) -> str:
+    try:
+        return str(np.dtype(getattr(aval, "dtype", None)))
+    except TypeError:  # extended dtypes (PRNG keys)
+        return str(getattr(aval, "dtype", "unknown"))
+
+
+def _shape_of(x: Any) -> tuple:
+    return tuple(getattr(getattr(x, "aval", None), "shape", ()) or ())
+
+
+def _check_downcast(eqn: Any, producers: dict, compute_name: str | None,
+                    findings: list, seen: set) -> None:
+    new = eqn.params.get("new_dtype")
+    try:
+        new_name = str(np.dtype(new))
+    except TypeError:
+        return
+    src = eqn.invars[0]
+    src_aval = getattr(src, "aval", None)
+    if src_aval is None or _dtype_name(src_aval) != "float32":
+        return
+    if new_name not in _LOW_FLOATS:
+        return
+    out_shape = _shape_of(eqn.outvars[0])
+    prod_eqn = producers.get(src)
+    prod_name = getattr(getattr(prod_eqn, "primitive", None), "name", None)
+    if out_shape == ():
+        msg = (
+            f"float32 scalar downcast to {new_name} — on the "
+            "loss/optimizer path this throws away the accumulated "
+            "precision (loss curves drift long before anything NaNs); "
+            "keep scalars in f32 and cast activations instead"
+        )
+        key = ("DT001", "scalar", new_name)
+    elif prod_name in _REDUCTIONS and new_name != compute_name:
+        msg = (
+            f"float32 output of {prod_name} downcast to {new_name} "
+            "(not the configured compute dtype) — the reduction was "
+            "accumulated wide and immediately narrowed; move the cast "
+            "before the reduction or keep the result wide"
+        )
+        key = ("DT001", prod_name, new_name)
+    else:
+        return
+    if key in seen:
+        return
+    seen.add(key)
+    findings.append(Finding(
+        "DT001", WARN, "dtype", f"<convert_element_type→{new_name}>", msg))
+
+
+def _check_f16_sum(eqn: Any, findings: list, seen: set) -> None:
+    name = eqn.primitive.name
+    in_names = {_dtype_name(v.aval) for v in eqn.invars
+                if not hasattr(v, "val") and hasattr(v, "aval")}
+    out_name = _dtype_name(eqn.outvars[0].aval)
+    if "float16" in in_names and out_name == "float16":
+        key = ("DT002", name)
+        if key in seen:
+            return
+        seen.add(key)
+        findings.append(Finding(
+            "DT002", WARN, "dtype", f"<{name}>",
+            f"{name} accumulates in float16 — partial sums overflow at "
+            "65504 even when every element is small; accumulate in "
+            "f32 (preferred_element_type=jnp.float32) or use bfloat16 "
+            "(f32 exponent range)",
+        ))
+
+
+def _check_weak_collective(eqn: Any, findings: list, seen: set) -> None:
+    name = eqn.primitive.name
+    for v in eqn.invars:
+        aval = getattr(v, "aval", None)
+        if aval is not None and getattr(aval, "weak_type", False):
+            key = ("DT003", name)
+            if key in seen:
+                return
+            seen.add(key)
+            findings.append(Finding(
+                "DT003", WARN, "dtype", f"<{name}>",
+                f"weak-typed operand ({_dtype_name(aval)}) enters "
+                f"{name} — the result dtype follows Python-literal "
+                "promotion rules at a collective boundary (differs "
+                "across devices/jax versions); cast to a concrete "
+                "dtype before the collective",
+            ))
+            return
+
+
+def _walk(jaxpr: Any, compute_name: str | None, findings: list,
+          seen: set) -> None:
+    producers: dict = {}
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "convert_element_type":
+            _check_downcast(eqn, producers, compute_name, findings, seen)
+        elif name in _SUM_PRIMS:
+            _check_f16_sum(eqn, findings, seen)
+        if name in COLLECTIVE_KINDS:
+            _check_weak_collective(eqn, findings, seen)
+        for v in eqn.outvars:
+            producers[v] = eqn
+        for v in eqn.params.values():
+            stack = [v]
+            while stack:
+                item = stack.pop()
+                sub = _jaxpr_of(item)
+                if sub is not None:
+                    _walk(sub, compute_name, findings, seen)
+                elif isinstance(item, (list, tuple)):
+                    stack.extend(item)
+
+
+def lint_param_dtypes(abstract_params: Any) -> list[Finding]:
+    """DT004: the param tree should agree on one float dtype."""
+    import jax
+
+    counts: dict[str, int] = {}
+    example: dict[str, str] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(
+            abstract_params)[0]:
+        try:
+            dt = np.dtype(getattr(leaf, "dtype", None))
+        except TypeError:
+            continue
+        if dt.kind != "f" and dt.name not in _LOW_FLOATS:
+            continue
+        counts[dt.name] = counts.get(dt.name, 0) + 1
+        example.setdefault(dt.name, jax.tree_util.keystr(path))
+    if len(counts) <= 1:
+        return []
+    parts = ", ".join(f"{n}×{c}" for n, c in sorted(counts.items()))
+    minority = min(counts, key=lambda n: counts[n])
+    return [Finding(
+        "DT004", WARN, "dtype", example[minority],
+        f"param tree mixes float dtypes ({parts}; e.g. "
+        f"{example[minority]} is {minority}) — grads and optimizer "
+        "updates promote per leaf, so effective precision differs "
+        "across the model; cast the tree or use a precision preset",
+    )]
+
+
+def lint_dtypes(
+    closed: Any,
+    *,
+    abstract_params: Any = None,
+    compute_dtype: Any = None,
+) -> list[Finding]:
+    """All dtype-layer rules over one traced step.
+
+    ``compute_dtype`` is the intended mixed-precision compute dtype
+    (``Precision.compute_dtype``): reduction outputs cast to it are the
+    configured policy, not a finding.
+    """
+    findings: list[Finding] = []
+    seen: set = set()
+    compute_name = None
+    if compute_dtype is not None:
+        try:
+            compute_name = str(np.dtype(compute_dtype))
+        except TypeError:
+            compute_name = None
+    jaxpr = _jaxpr_of(closed)
+    if jaxpr is not None:
+        _walk(jaxpr, compute_name, findings, seen)
+    if abstract_params is not None:
+        findings += lint_param_dtypes(abstract_params)
+    return findings
+
+
+__all__ = ["lint_dtypes", "lint_param_dtypes"]

@@ -1,0 +1,454 @@
+"""Memory lint: liveness-based per-device peak-HBM prediction (ML00x).
+
+A single-pass abstract interpretation over the traced (unjitted) train
+step — the same ``jax.make_jaxpr`` trace ``graph_lint`` walks — that
+predicts the per-device resident-byte peak **before anything compiles**:
+
+- *persistent* terms (params, optimizer state, model state, the batch)
+  are charged per device through the plan's real ``PartitionSpec`` tree
+  × a plain mesh-degrees mapping (``topology.mesh_degrees`` accepts
+  both), so sharded vs replicated-because-indivisible leaves are
+  accounted exactly as GSPMD would lay them out;
+- the *transient* term walks the jaxpr equations in order, tracking
+  each value from its defining equation to its last use (liveness
+  intervals) and taking the max resident set.  Sub-jaxprs (scan bodies,
+  cond branches, remat regions) contribute their own internal peak at
+  the point they execute — which is also how grad-accum microbatching
+  and remat show up: the traced step already contains the smaller
+  microbatch slices and the rematerialized (not stored) forward, so the
+  walk sees their reduced footprint with no special-casing.
+
+Intermediates carry no PartitionSpecs (GSPMD assigns them at compile
+time), so the walk classifies each value by shape — param-shaped
+(grads, optimizer temporaries: scaled by the plan's average param shard
+fraction), batch-leading (activations: divided by the batch-axis
+degree), or other (charged in full) — a deliberate coarse model; the
+acceptance bar is "within 2× of XLA's compiled peak", not exactness.
+
+Everything is device-free: the same classified walk at global shapes
+(:func:`activation_profile`) feeds the tuner's memory pruning
+(``tune/space.py``), scoring hypothetical meshes that were never built.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, Iterator, Mapping
+
+import numpy as np
+
+from .. import planner as planner_mod
+from .. import topology as topo_mod
+from . import ERROR, WARN, Finding
+from .graph_lint import _jaxpr_of
+
+# Warn (ML002) when the predicted peak lands within this fraction below
+# the budget: the estimate is coarse, so a near-miss is a real risk.
+DEFAULT_HEADROOM = 0.1
+
+# Transient share of the peak above which "turn on remat" (ML003) is
+# worth saying when the budget is already tight.
+_ACT_DOMINANT = 0.5
+
+_CLASSES = ("param_like", "batch", "other")
+
+
+def _fmt_bytes(n: float) -> str:
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.2f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return f"{n:.2f} TiB"
+
+
+def _aval_bytes(aval: Any) -> int:
+    shape = tuple(getattr(aval, "shape", ()) or ())
+    try:
+        itemsize = np.dtype(getattr(aval, "dtype", np.float32)).itemsize
+    except TypeError:
+        itemsize = 4  # extended dtypes (PRNG keys): close enough
+    return (math.prod(shape) if shape else 1) * itemsize
+
+
+def _leaf_bytes(leaf: Any) -> int:
+    return _aval_bytes(leaf)
+
+
+def _sub_jaxprs(eqn: Any) -> Iterator[Any]:
+    for v in eqn.params.values():
+        stack = [v]
+        while stack:
+            item = stack.pop()
+            sub = _jaxpr_of(item)
+            if sub is not None:
+                yield sub
+            elif isinstance(item, (list, tuple)):
+                stack.extend(item)
+
+
+def _walk_liveness(
+    jaxpr: Any,
+    mult: Callable[[str], float],
+    classify: Callable[[Any], str],
+    *,
+    skip: frozenset = frozenset(),
+) -> tuple[float, dict[str, float]]:
+    """(peak_bytes, by_class_at_peak) for one jaxpr level.
+
+    An equation output is resident from its defining equation to its
+    last use (jaxpr outputs stay resident to the end; never-used
+    outputs die immediately); the peak is the max over equations of the
+    resident set plus the internal peak of any sub-jaxpr executing
+    there.  ``mult(class)`` scales a value to per-device bytes;
+    ``skip`` marks values charged elsewhere (donated outputs alias
+    their inputs) as zero-size at this level only.
+    """
+    eqns = list(getattr(jaxpr, "eqns", ()))
+    last_use: dict[Any, int] = {}
+    for i, eqn in enumerate(eqns):
+        for v in eqn.invars:
+            if not hasattr(v, "val"):  # skip Literals
+                last_use[v] = i
+    for v in jaxpr.outvars:
+        if not hasattr(v, "val"):
+            last_use[v] = len(eqns)
+    live: dict[Any, tuple[str, float]] = {}
+    peak = 0.0
+    peak_by_class: dict[str, float] = {}
+    for i, eqn in enumerate(eqns):
+        for v in eqn.outvars:
+            cls = classify(v.aval)
+            b = 0.0 if v in skip else _aval_bytes(v.aval) * mult(cls)
+            live[v] = (cls, b)
+        inner_peak = 0.0
+        inner_classes: dict[str, float] = {}
+        for sub in _sub_jaxprs(eqn):
+            p, c = _walk_liveness(sub, mult, classify)
+            if p > inner_peak:
+                inner_peak, inner_classes = p, c
+        total = sum(b for _, b in live.values()) + inner_peak
+        if total > peak:
+            peak = total
+            peak_by_class = dict(inner_classes)
+            for cls, b in live.values():
+                peak_by_class[cls] = peak_by_class.get(cls, 0.0) + b
+        dead = [v for v in live if last_use.get(v, i) <= i]
+        for v in dead:
+            live.pop(v)
+    return peak, peak_by_class
+
+
+# -- shape classification ----------------------------------------------------
+
+
+def _param_shapes(abstract_params: Any) -> frozenset:
+    import jax
+
+    shapes = set()
+    for leaf in jax.tree.leaves(abstract_params):
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        if shape:
+            shapes.add(shape)
+    return frozenset(shapes)
+
+
+def _batch_dims(batch: Any, grad_accum: int) -> frozenset:
+    import jax
+
+    dims = set()
+    for leaf in jax.tree.leaves(batch if batch is not None else {}):
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        if shape and shape[0] > 1:
+            dims.add(int(shape[0]))
+            if grad_accum > 1 and shape[0] % grad_accum == 0:
+                dims.add(int(shape[0]) // grad_accum)
+    return frozenset(dims)
+
+
+def make_classifier(
+    abstract_params: Any, batch: Any, grad_accum: int = 1
+) -> Callable[[Any], str]:
+    """aval -> 'param_like' | 'batch' | 'other'.
+
+    Param-shaped wins (a grad accumulator must never be mistaken for an
+    activation just because a weight dim divides the batch size);
+    'batch' means the leading dim is a multiple of a batch (or
+    microbatch) leading dim, i.e. the value scales with items/device.
+    """
+    pshapes = _param_shapes(abstract_params)
+    bdims = _batch_dims(batch, grad_accum)
+
+    def classify(aval: Any) -> str:
+        shape = tuple(getattr(aval, "shape", ()) or ())
+        if shape in pshapes:
+            return "param_like"
+        if shape and any(
+            shape[0] == b or shape[0] % b == 0 for b in bdims if b > 1
+        ):
+            return "batch"
+        return "other"
+
+    return classify
+
+
+# -- sharded persistent-state accounting -------------------------------------
+
+
+def _spec_fraction(spec: Any, degrees: Mapping[str, int]) -> int:
+    frac = 1
+    for ax in planner_mod.spec_axes(spec):
+        frac *= int(degrees.get(ax, 1))
+    return max(1, frac)
+
+
+def sharded_tree_bytes(
+    tree: Any, specs: Any, degrees: Mapping[str, int]
+) -> tuple[int, int]:
+    """(per_device_bytes, global_bytes) of a pytree under its spec tree
+    — replicated-because-unsharded leaves charged in full."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    spec_leaves = jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P))
+    leaves = jax.tree.leaves(tree)
+    per_dev = 0.0
+    total = 0
+    for spec, leaf in zip(spec_leaves, leaves):
+        b = _leaf_bytes(leaf)
+        total += b
+        per_dev += b / _spec_fraction(spec, degrees)
+    return int(per_dev), int(total)
+
+
+def _shape_fracs(
+    abstract_params: Any, specs: Any, degrees: Mapping[str, int]
+) -> dict:
+    """Param shape -> shard fraction, for charging optimizer-state
+    leaves (optax moment trees mirror the param tree leaf-for-leaf, so
+    a shape match inherits the param leaf's sharding)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    spec_leaves = jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P))
+    leaves = jax.tree.leaves(abstract_params)
+    out: dict = {}
+    for spec, leaf in zip(spec_leaves, leaves):
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        if shape:
+            out[shape] = max(out.get(shape, 1), _spec_fraction(spec, degrees))
+    return out
+
+
+def _matched_tree_bytes(tree: Any, shape_fracs: Mapping) -> int:
+    """Per-device bytes of a tree whose leaves shard like the param leaf
+    of matching shape (unmatched leaves — counts, schedules — stay
+    replicated)."""
+    import jax
+
+    per_dev = 0.0
+    for leaf in jax.tree.leaves(tree):
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        per_dev += _leaf_bytes(leaf) / shape_fracs.get(shape, 1)
+    return int(per_dev)
+
+
+def _batch_degree(batch_spec: Any, degrees: Mapping[str, int]) -> int:
+    deg = 1
+    for ax in planner_mod.spec_axes(batch_spec) if batch_spec is not None else ():
+        deg *= int(degrees.get(ax, 1))
+    return max(1, deg)
+
+
+# -- the estimate ------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class MemEstimate:
+    """Per-device predicted residency, broken down the way ``tadnn
+    report`` renders it (params/optimizer/activations/peak)."""
+
+    params_bytes: int
+    optimizer_bytes: int
+    model_state_bytes: int
+    batch_bytes: int
+    activation_bytes: int  # transient liveness peak
+    peak_bytes: int
+    strategy: str
+    degrees: dict
+    grad_accum: int
+    remat: bool
+    transient_by_class: dict
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def estimate_step_memory(
+    closed: Any,
+    plan: Any,
+    abstract_params: Any,
+    *,
+    opt_state: Any = None,
+    model_state: Any = None,
+    batch: Any = None,
+    grad_accum: int = 1,
+    degrees: Mapping[str, int] | None = None,
+    donated: bool = True,
+) -> MemEstimate:
+    """Predict the per-device resident-byte peak of one train step.
+
+    ``closed`` is the traced (unjitted) step jaxpr — pass None to get
+    the persistent-state terms only.  ``plan`` may be a real
+    :class:`planner.ShardPlan` or an abstract one whose mesh is a plain
+    degrees mapping (the tuner's hypothetical-mesh path).  With
+    ``donated`` (the default, matching AutoDistribute's donate=True),
+    top-level step outputs alias the input state and are not charged a
+    second time.
+    """
+    import jax
+
+    deg = topo_mod.mesh_degrees(degrees if degrees is not None else plan.mesh)
+    params_pd, params_total = sharded_tree_bytes(
+        abstract_params, plan.param_specs, deg)
+    param_mult = params_pd / max(1, params_total)
+    opt_pd = 0
+    if opt_state is not None:
+        fracs = _shape_fracs(abstract_params, plan.param_specs, deg)
+        opt_pd = _matched_tree_bytes(opt_state, fracs)
+    ms_pd = sum(
+        _leaf_bytes(leaf)
+        for leaf in jax.tree.leaves(model_state if model_state is not None
+                                    else {})
+    )
+    batch_deg = _batch_degree(getattr(plan, "batch_spec", None), deg)
+    batch_pd = int(sum(
+        _leaf_bytes(leaf)
+        for leaf in jax.tree.leaves(batch if batch is not None else {})
+    ) / batch_deg)
+    act_pd = 0
+    by_class: dict[str, float] = {}
+    if closed is not None:
+        jaxpr = _jaxpr_of(closed)
+        classify = make_classifier(abstract_params, batch, grad_accum)
+        mult = {"param_like": param_mult, "batch": 1.0 / batch_deg,
+                "other": 1.0}
+        # outvars may contain (unhashable) Literals — constant outputs
+        # occupy no buffer, so they are not skip-set material anyway
+        skip = (frozenset(v for v in jaxpr.outvars
+                          if not hasattr(v, "val"))
+                if donated else frozenset())
+        peak, by_class = _walk_liveness(
+            jaxpr, lambda c: mult[c], classify, skip=skip)
+        act_pd = int(peak)
+    return MemEstimate(
+        params_bytes=params_pd,
+        optimizer_bytes=opt_pd,
+        model_state_bytes=ms_pd,
+        batch_bytes=batch_pd,
+        activation_bytes=act_pd,
+        peak_bytes=params_pd + opt_pd + ms_pd + batch_pd + act_pd,
+        strategy=str(getattr(plan, "strategy", "custom")),
+        degrees={a: n for a, n in deg.items() if n > 1},
+        grad_accum=int(grad_accum),
+        remat=bool(getattr(plan, "remat", False)),
+        transient_by_class={k: int(v) for k, v in by_class.items()},
+    )
+
+
+def resolve_budget(
+    budget: int | str | None = None, device_kind: str | None = None
+) -> int:
+    """An HBM budget in bytes: explicit int, a size string ('16GiB'),
+    or — when None — the detected chip's ``ChipSpec.hbm_bytes``."""
+    if budget is not None:
+        if isinstance(budget, str):
+            return topo_mod.parse_size(budget)
+        return int(budget)
+    kind = device_kind or topo_mod.detect().device_kind
+    return int(topo_mod.chip_spec(kind).hbm_bytes)
+
+
+def lint_memory(
+    est: MemEstimate,
+    *,
+    budget_bytes: int,
+    headroom: float = DEFAULT_HEADROOM,
+    where: str = "<step>",
+) -> list[Finding]:
+    """ML001 (over budget = predicted OOM), ML002 (inside the headroom
+    margin), ML003 (tight + activation-dominated with remat off)."""
+    findings: list[Finding] = []
+    peak = est.peak_bytes
+    budget = int(budget_bytes)
+    mesh = "×".join(f"{a}{n}" for a, n in sorted(est.degrees.items())) or "1"
+    if peak > budget:
+        findings.append(Finding(
+            "ML001", ERROR, "mem", where,
+            f"predicted per-device peak {_fmt_bytes(peak)} exceeds the "
+            f"HBM budget {_fmt_bytes(budget)} (strategy "
+            f"{est.strategy!r}, mesh {mesh}: params "
+            f"{_fmt_bytes(est.params_bytes)} + optimizer "
+            f"{_fmt_bytes(est.optimizer_bytes)} + activations "
+            f"{_fmt_bytes(est.activation_bytes)}) — this plan would "
+            "OOM; shard more, raise grad_accum, or enable remat",
+        ))
+    elif peak > (1.0 - headroom) * budget:
+        findings.append(Finding(
+            "ML002", WARN, "mem", where,
+            f"predicted per-device peak {_fmt_bytes(peak)} is within "
+            f"{headroom:.0%} of the {_fmt_bytes(budget)} budget "
+            f"(strategy {est.strategy!r}, mesh {mesh}) — the static "
+            "estimate is coarse; XLA scheduling or fragmentation can "
+            "push this over",
+        ))
+    if (
+        findings
+        and not est.remat
+        and est.activation_bytes >= _ACT_DOMINANT * max(1, peak)
+    ):
+        findings.append(Finding(
+            "ML003", WARN, "mem", where,
+            f"activations are {est.activation_bytes / max(1, peak):.0%} "
+            "of the predicted peak and remat is off — gradient "
+            "checkpointing (remat=True) or a larger grad_accum would "
+            "cut the transient term",
+        ))
+    return findings
+
+
+# -- the tuner-facing profile ------------------------------------------------
+
+
+def activation_profile_from_trace(
+    closed: Any, abstract_params: Any, batch: Any
+) -> dict:
+    """Classified liveness peak of one traced step at GLOBAL shapes —
+    the reusable half of the estimator the tuner rescales per candidate
+    (``tune/space.py``): the batch-proportional term scales with
+    items/device ÷ grad_accum, the param-shaped term with the
+    candidate's param shard fraction, the rest is charged in full."""
+    jaxpr = _jaxpr_of(closed)
+    classify = make_classifier(abstract_params, batch, 1)
+    skip = frozenset(v for v in jaxpr.outvars if not hasattr(v, "val"))
+    peak, by_class = _walk_liveness(
+        jaxpr, lambda c: 1.0, classify, skip=skip)
+    return {
+        "peak_bytes": int(peak),
+        "batch_bytes": int(by_class.get("batch", 0)),
+        "param_like_bytes": int(by_class.get("param_like", 0)),
+        "other_bytes": int(by_class.get("other", 0)),
+    }
+
+
+__all__ = [
+    "DEFAULT_HEADROOM",
+    "MemEstimate",
+    "activation_profile_from_trace",
+    "estimate_step_memory",
+    "lint_memory",
+    "make_classifier",
+    "resolve_budget",
+    "sharded_tree_bytes",
+]

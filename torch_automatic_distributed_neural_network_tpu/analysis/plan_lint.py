@@ -30,9 +30,19 @@ from .. import planner as planner_mod
 from .. import topology as topo_mod
 from . import ERROR, WARN, Finding
 
-# Leaves bigger than this that stay fully replicated under a sharding
-# strategy get a PL005 warning (override with big_leaf_bytes=).
+# Fallback PL005 threshold when the rule table carries none — the live
+# default is RULES['PL005'].threshold (override per call with
+# big_leaf_bytes= / `tadnn check --pl005-bytes`).
 BIG_LEAF_BYTES = 64 * 2**20
+
+
+def _pl005_threshold(big_leaf_bytes: int | None) -> int:
+    if big_leaf_bytes is not None:
+        return int(big_leaf_bytes)
+    from . import RULES
+
+    t = RULES["PL005"].threshold
+    return int(t) if t is not None else BIG_LEAF_BYTES
 
 # Axes that legitimately never appear in a *param* spec: they carry
 # activations (context parallelism) — not dead just because no leaf or
@@ -69,7 +79,7 @@ def lint_specs(
     strategy: str,
     abstract_params: Any | None = None,
     *,
-    big_leaf_bytes: int = BIG_LEAF_BYTES,
+    big_leaf_bytes: int | None = None,
 ) -> list[Finding]:
     """The pure core: lint a spec tree against a degrees mapping.
 
@@ -81,6 +91,7 @@ def lint_specs(
     import jax
 
     degrees = topo_mod.mesh_degrees(degrees)
+    big_leaf_bytes = _pl005_threshold(big_leaf_bytes)
     findings: list[Finding] = []
     flat_specs = planner_mod._flatten_with_paths(param_specs)
     leaves_by_path: dict[str, Any] = {}
@@ -149,7 +160,8 @@ def lint_specs(
         ):
             findings.append(Finding(
                 "PL005", WARN, "plan", path,
-                f"{_leaf_bytes(leaf) / 2**20:.1f} MiB leaf is fully "
+                f"{_leaf_bytes(leaf) / 2**20:.1f} MiB leaf (> threshold "
+                f"{big_leaf_bytes / 2**20:.1f} MiB) is fully "
                 f"replicated under strategy {strategy!r} — every device "
                 "holds a full copy (silent HBM cost); add a sharding "
                 "rule or check axis divisibility",
@@ -181,7 +193,7 @@ def lint_plan(
     plan: planner_mod.ShardPlan,
     abstract_params: Any | None = None,
     *,
-    big_leaf_bytes: int = BIG_LEAF_BYTES,
+    big_leaf_bytes: int | None = None,
 ) -> list[Finding]:
     """Lint a planner-built (or hand-built) :class:`ShardPlan`."""
     return lint_specs(

@@ -106,10 +106,10 @@ def cmd_bench(args: argparse.Namespace) -> int:
 
 def _family_setup(args: argparse.Namespace):
     """(model, loss_fn, sample_batch) for the model-zoo CLI commands
-    (fit, tune) from --family/--size/--seq/--batch."""
+    (fit, tune, check --memory) from --family/--size/--seq/--batch."""
     import numpy as np
 
-    from .models import GPT2, Bert, Llama, MoE, ViT
+    from .models import GPT2, MLP, Bert, Llama, MoE, ViT
     from .training import (
         blockwise_next_token_loss,
         masked_lm_loss,
@@ -118,6 +118,18 @@ def _family_setup(args: argparse.Namespace):
         softmax_xent_loss,
     )
 
+    if args.family == "mlp":
+        # the bench model: --size is the comma-separated layer widths,
+        # --seq the (square) input image side
+        feats = tuple(
+            int(x) for x in (args.size or "1024,1024,10").split(","))
+        side = args.seq or 28
+        model = MLP(features=feats)
+        sample = {
+            "x": np.zeros((args.batch, side * side), np.float32),
+            "label": np.zeros((args.batch,), np.int32),
+        }
+        return model, softmax_xent_loss, sample
     family = {"gpt2": GPT2, "llama": Llama, "moe": MoE,
               "bert": Bert, "vit": ViT}[args.family]
     size = args.size or {"gpt2": "1p3b", "llama": "8b", "moe": "test",
@@ -227,11 +239,17 @@ def cmd_tune(args: argparse.Namespace) -> int:
     abstract, _ = ad._split_variables(abstract_vars)
 
     topo = topology.detect()
+    act_profile = None
+    try:
+        act_profile = ad.activation_profile(rng, sample)
+    except Exception:  # profile is advisory — rank on the heuristic
+        act_profile = None
     policy = tune.TunePolicy(
         grad_accums=tuple(int(g) for g in args.grad_accums.split(",")),
         top_k=args.top_k,
         batch_items=tune.estimate_batch_items(sample),
         use_cache=not args.no_cache,
+        act_profile=act_profile,
     )
     result = tune.tune(abstract, topo, policy=policy)
     ranked = result.ranked
@@ -240,11 +258,13 @@ def cmd_tune(args: argparse.Namespace) -> int:
             abstract, topo, grad_accums=policy.grad_accums,
             max_tensor=policy.max_tensor, state_factor=policy.state_factor,
             batch_items=policy.batch_items, safety=policy.safety,
+            act_profile=policy.act_profile,
         )
         ranked = tune.rank(abstract, topo, kept,
                            state_factor=policy.state_factor,
                            batch_items=policy.batch_items,
-                           safety=policy.safety) if kept else []
+                           safety=policy.safety,
+                           act_profile=policy.act_profile) if kept else []
 
     measured: dict[str, float] = {}
     if args.measure and ranked:
@@ -337,10 +357,52 @@ def cmd_doctor(args: argparse.Namespace) -> int:
     return 0 if report["healthy"] else 1
 
 
+def _fmt_mem_bytes(n) -> str:
+    if n is None:
+        return "-"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.2f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return f"{n:.2f} GiB"
+
+
+def _print_memory_report(report: dict) -> None:
+    rows = [
+        ("params", report.get("params_bytes")),
+        ("optimizer", report.get("optimizer_bytes")),
+        ("model_state", report.get("model_state_bytes")),
+        ("batch", report.get("batch_bytes")),
+        ("activations", report.get("activation_bytes")),
+        ("peak", report.get("peak_bytes")),
+        ("budget", report.get("budget_bytes")),
+    ]
+    mesh = "x".join(f"{a}{n}" for a, n in
+                    sorted((report.get("degrees") or {}).items()))
+    print(f"memory estimate (static, per device; strategy "
+          f"{report.get('strategy')}, mesh {mesh or '1'}, "
+          f"grad_accum {report.get('grad_accum')}, "
+          f"remat {'on' if report.get('remat') else 'off'}):")
+    for name, val in rows:
+        if name == "model_state" and not val:
+            continue
+        print(f"  {name:<12} {_fmt_mem_bytes(val):>12}")
+    comp = report.get("compiled") or {}
+    peak_c = comp.get("per_device_peak_bytes")
+    if peak_c:
+        print(f"  {'xla peak':<12} {_fmt_mem_bytes(peak_c):>12}  "
+              f"(static/compiled {report.get('static_over_compiled')}x)")
+    elif comp.get("error"):
+        print(f"  xla peak: unavailable ({comp['error']})")
+
+
 def cmd_check(args: argparse.Namespace) -> int:
     """Static analyzer (analysis/): source lint over the repo's Python
     by default; ``--preflight FILE`` adds plan + graph lint driven by
-    the file's ``tadnn_check()`` dict.  Exit 1 on error-severity
+    the file's ``tadnn_check()`` dict; ``--memory`` builds a model-zoo
+    config (--family/--batch/--strategy) and runs the liveness
+    peak-HBM estimator against ``--budget``.  Exit 1 on error-severity
     findings; with ``--strict`` also on warnings."""
     from . import analysis
 
@@ -365,17 +427,47 @@ def cmd_check(args: argparse.Namespace) -> int:
             print(f"{args.preflight} does not define tadnn_check()",
                   file=sys.stderr)
             return 2
-        findings += analysis.check_spec(hook())
+        hook_spec = dict(hook())
+        if args.pl005_bytes is not None:
+            hook_spec.setdefault("big_leaf_bytes", args.pl005_bytes)
+        findings += analysis.check_spec(hook_spec)
+    mem_report = None
+    if args.memory:
+        import jax
+        import optax
+
+        from . import AutoDistribute
+
+        model, loss, sample = _family_setup(args)
+        ad = AutoDistribute(
+            model, optimizer=optax.adamw(1e-4), loss_fn=loss,
+            strategy=args.strategy, precision=args.precision,
+            grad_accum=args.grad_accum,
+        )
+        mem_findings, mem_report = analysis.memory_check(
+            ad, sample, rng=jax.random.key(0), budget=args.budget,
+            headroom=args.headroom, big_leaf_bytes=args.pl005_bytes,
+            compiled=not args.no_compiled,
+        )
+        findings += mem_findings
+    try:
+        findings = analysis.filter_ignored(findings, args.ignore or ())
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
     analysis.journal_findings(findings, phase="check")
     summary = analysis.summarize(findings)
     if args.json:
-        print(json.dumps({
-            "findings": [f.to_json() for f in findings],
-            "summary": summary,
-        }))
+        out = {"findings": [f.to_json() for f in findings],
+               "summary": summary}
+        if mem_report is not None:
+            out["memory"] = mem_report
+        print(json.dumps(out))
     else:
         for f in findings:
             print(f.format())
+        if mem_report is not None:
+            _print_memory_report(mem_report)
         print(f"tadnn check: {summary['errors']} error(s), "
               f"{summary['warnings']} warning(s)")
     return analysis.exit_code(findings, strict=args.strict)
@@ -430,11 +522,12 @@ def main(argv: list[str] | None = None) -> int:
              "escalation ladder and reports every candidate",
     )
     p.add_argument("--family", default="gpt2",
-                   choices=("gpt2", "llama", "moe", "bert", "vit"))
+                   choices=("mlp", "gpt2", "llama", "moe", "bert", "vit"))
     p.add_argument("--size", default=None,
                    help="model size preset; default per family "
                         "(gpt2: 1p3b, llama: 8b, moe: test, bert: large, "
-                        "vit: large); for vit, --seq is the image side")
+                        "vit: large); for vit, --seq is the image side; "
+                        "for mlp, comma-separated layer widths")
     p.add_argument("--seq", type=int, default=None,
                    help="sequence length (default 1024); for vit, the "
                         "image side (default 224)")
@@ -454,11 +547,12 @@ def main(argv: list[str] | None = None) -> int:
              "compiles and times the top-k on the real train step",
     )
     p.add_argument("--family", default="gpt2",
-                   choices=("gpt2", "llama", "moe", "bert", "vit"))
+                   choices=("mlp", "gpt2", "llama", "moe", "bert", "vit"))
     p.add_argument("--size", default=None,
                    help="model size preset; default per family "
                         "(gpt2: 1p3b, llama: 8b, moe: test, bert: large, "
-                        "vit: large); for vit, --seq is the image side")
+                        "vit: large); for vit, --seq is the image side; "
+                        "for mlp, comma-separated layer widths")
     p.add_argument("--seq", type=int, default=None)
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--precision", default="fp32")
@@ -502,9 +596,10 @@ def main(argv: list[str] | None = None) -> int:
 
     p = sub.add_parser(
         "check",
-        help="static analyzer: source lint over the repo (and plan/graph "
-             "lint with --preflight FILE); exit 1 on errors, with "
-             "--strict also on warnings",
+        help="static analyzer: source lint over the repo (plan/graph "
+             "lint with --preflight FILE, liveness peak-HBM + dtype "
+             "lint with --memory); exit 1 on errors, with --strict "
+             "also on warnings",
     )
     p.add_argument("paths", nargs="*",
                    help="files/dirs to source-lint (default: the "
@@ -517,10 +612,47 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--preflight", default=None, metavar="FILE",
                    help="python file defining tadnn_check() -> dict with "
                         "keys among plan/abstract_params/param_specs/"
-                        "batch_spec/degrees/strategy/fn/args/static_args; "
-                        "runs plan + graph lint on it")
+                        "batch_spec/degrees/strategy/fn/args/static_args/"
+                        "budget; runs plan + graph + mem + dtype lint "
+                        "on it")
     p.add_argument("--no-source", action="store_true",
-                   help="skip the source lint (only --preflight layers)")
+                   help="skip the source lint (only --preflight/--memory "
+                        "layers)")
+    p.add_argument("--memory", action="store_true",
+                   help="trace a model-zoo config (--family et al.) and "
+                        "predict its per-device peak HBM against "
+                        "--budget (ML001 error when it would OOM)")
+    p.add_argument("--budget", default=None,
+                   help="HBM budget for --memory, e.g. '16GiB' "
+                        "(default: the detected chip's ChipSpec)")
+    p.add_argument("--headroom", type=float, default=None,
+                   help="warn (ML002) when the predicted peak is within "
+                        "this fraction of the budget (default 0.1)")
+    p.add_argument("--no-compiled", action="store_true",
+                   help="skip the XLA compiled_cost cross-check (stay "
+                        "fully device-free / trace-only)")
+    p.add_argument("--ignore", action="append", default=[],
+                   metavar="CODE",
+                   help="suppress findings with this rule code "
+                        "(repeatable) — the plan/graph/mem/dtype analog "
+                        "of '# tadnn: lint-ok(CODE)'")
+    p.add_argument("--pl005-bytes", type=int, default=None,
+                   help="PL005 'large replicated leaf' byte threshold "
+                        "(default: the rule table's, 64 MiB)")
+    p.add_argument("--family", default="mlp",
+                   choices=("mlp", "gpt2", "llama", "moe", "bert", "vit"),
+                   help="model for --memory (default: the bench mlp)")
+    p.add_argument("--size", default=None,
+                   help="model size preset; for mlp, comma-separated "
+                        "layer widths (default 1024,1024,10)")
+    p.add_argument("--seq", type=int, default=None,
+                   help="sequence length; for mlp/vit, the input image "
+                        "side (mlp default 28)")
+    p.add_argument("--batch", type=int, default=256)
+    p.add_argument("--strategy", default="fsdp",
+                   help="sharding strategy for --memory (default fsdp)")
+    p.add_argument("--precision", default="fp32")
+    p.add_argument("--grad-accum", type=int, default=1)
     p.set_defaults(fn=cmd_check)
 
     p = sub.add_parser(

@@ -277,15 +277,24 @@ class AutoDistribute:
         )
         tune_policy = None
         if self._strategy == "tuned":
-            # the tuner sees the real batch (tokens/items per step) and
-            # the configured accumulation, so its memory/cost estimates
+            # the tuner sees the real batch (tokens/items per step), the
+            # configured accumulation, and a liveness activation profile
+            # of the real traced step, so its memory/cost estimates
             # match what this AutoDistribute will actually run
             from . import tune as tune_mod
 
+            act_profile = None
+            try:
+                act_profile = self.activation_profile(rng, sample_batch)
+            except Exception as e:  # profile is advisory, never fatal
+                obs_journal.event(
+                    "tune.profile_skipped",
+                    error=f"{type(e).__name__}: {e}")
             tune_policy = tune_mod.TunePolicy(
                 batch_items=tune_mod.estimate_batch_items(sample_batch),
                 grad_accums=(self._grad_accum,),
                 state_factor=state_factor,
+                act_profile=act_profile,
             )
         self.plan = planner_mod.make_plan(
             abstract,
@@ -645,6 +654,70 @@ class AutoDistribute:
                       - int(mem.get("alias_size", 0)))
             )
         return {**cost, "per_device_peak_bytes": peak}
+
+    def activation_profile(self, rng: jax.Array,
+                           sample_batch: Any) -> dict | None:
+        """Global-shape liveness activation profile of this model's
+        train step — the tuner's memory-pruning input (``tune/space.py``
+        via ``analysis.mem_lint``).
+
+        Traced meshless with ``jax.make_jaxpr`` on abstract shapes: no
+        plan, mesh, or devices needed, so it runs BEFORE the tuner
+        picks one.  Two variants (remat on/off) let the tuner charge
+        each candidate the transient footprint its strategy would
+        actually see.  Returns None for stateful models (the meshless
+        step cannot thread batch stats).
+        """
+        from . import tune as tune_mod
+        from .analysis import mem_lint
+
+        abstract_vars = jax.eval_shape(
+            self._init_variables, rng, sample_batch)
+        abstract, abstract_ms = self._split_variables(abstract_vars)
+        if jax.tree.leaves(abstract_ms):
+            return None
+        prec = self.precision
+        cast_for_compute = np.dtype(prec.compute_dtype) != np.dtype(
+            prec.param_dtype)
+        opt_abs = jax.eval_shape(self.optimizer.init, abstract)
+        batch_abs = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
+            sample_batch,
+        )
+
+        def step_with(remat):
+            def step(params, opt_state, batch, rng):
+                compute = (
+                    precision_mod.cast_floats(params, prec.compute_dtype)
+                    if cast_for_compute else params
+                )
+
+                def loss_inner(p):
+                    return self._loss_for(p, {}, batch, rng)
+
+                if remat:
+                    loss_inner = jax.checkpoint(
+                        loss_inner,
+                        policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+                    )
+                (loss, _aux), grads = jax.value_and_grad(
+                    loss_inner, has_aux=True)(compute)
+                updates, new_opt = self.optimizer.update(
+                    grads, opt_state, params)
+                new_params = optax.apply_updates(params, updates)
+                return new_params, new_opt, loss
+
+            return step
+
+        profile: dict = {
+            "batch_items": tune_mod.estimate_batch_items(sample_batch),
+        }
+        for name, remat in (("noremat", False), ("remat", True)):
+            closed = jax.make_jaxpr(step_with(remat))(
+                abstract, opt_abs, batch_abs, jax.random.key(0))
+            profile[name] = mem_lint.activation_profile_from_trace(
+                closed, abstract, batch_abs)
+        return profile
 
     def _check_batch(self, batch) -> None:
         """Fail with a readable message when the global batch does not divide

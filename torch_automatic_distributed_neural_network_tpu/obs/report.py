@@ -224,6 +224,17 @@ def generate(target: str, metrics_path: str | None = None) -> dict:
         if lint_skipped:
             lint["skipped"] = lint_skipped.get("error")
         report["lint"] = {k: v for k, v in lint.items() if v is not None}
+    mem_est = last("lint.mem_estimate")
+    if mem_est:
+        keys = ("params_bytes", "optimizer_bytes", "model_state_bytes",
+                "batch_bytes", "activation_bytes", "peak_bytes",
+                "budget_bytes", "strategy", "degrees", "grad_accum",
+                "remat", "phase", "static_over_compiled")
+        me = {k: mem_est.get(k) for k in keys if mem_est.get(k) is not None}
+        compiled = mem_est.get("compiled") or {}
+        if compiled.get("per_device_peak_bytes"):
+            me["compiled_peak_bytes"] = compiled["per_device_peak_bytes"]
+        report["memory_estimate"] = me
     if metrics_path and os.path.isfile(metrics_path):
         recs = _read_metrics(metrics_path)
         steps = [r for r in recs if "step_time_s" in r]
@@ -377,6 +388,27 @@ def format_report(report: dict) -> str:
                          f"{f.get('where')}: {f.get('msg')}")
         if lint.get("skipped"):
             lines.append(f"  preflight skipped: {lint['skipped']}")
+    me = report.get("memory_estimate")
+    if me:
+        mesh = "x".join(f"{a}{n}" for a, n in
+                        sorted((me.get("degrees") or {}).items()))
+        head = (f"memory estimate (static, per device): peak "
+                f"{_fmt_bytes(me.get('peak_bytes'))}")
+        if me.get("budget_bytes"):
+            head += f" / budget {_fmt_bytes(me['budget_bytes'])}"
+        head += (f"  [{me.get('strategy')} mesh {mesh or '1'}"
+                 f"{', remat' if me.get('remat') else ''}]")
+        lines.append(head)
+        lines.append(
+            f"  params {_fmt_bytes(me.get('params_bytes'))}"
+            f"  optimizer {_fmt_bytes(me.get('optimizer_bytes'))}"
+            f"  activations {_fmt_bytes(me.get('activation_bytes'))}"
+            f"  batch {_fmt_bytes(me.get('batch_bytes'))}")
+        if me.get("compiled_peak_bytes"):
+            lines.append(
+                f"  xla compiled peak "
+                f"{_fmt_bytes(me['compiled_peak_bytes'])} "
+                f"(static/compiled {me.get('static_over_compiled')}x)")
     bi = report.get("bench_incidents")
     if bi:
         lines.append(f"bench incidents: {len(bi)}")

@@ -122,6 +122,35 @@ def chip_spec(device_kind: str) -> ChipSpec:
     return _DEFAULT_CHIP
 
 
+_SIZE_UNITS = {
+    "": 1, "B": 1,
+    "KB": 10**3, "MB": 10**6, "GB": 10**9, "TB": 10**12,
+    "KIB": 2**10, "MIB": 2**20, "GIB": 2**30, "TIB": 2**40,
+    # Bare K/M/G/T read as the binary units HBM sizes are quoted in.
+    "K": 2**10, "M": 2**20, "G": 2**30, "T": 2**40,
+}
+
+
+def parse_size(s: str | int | float) -> int:
+    """'16GiB' / '95 GB' / '1.5e9' / 8589934592 → bytes.
+
+    Binary suffixes (KiB/MiB/GiB/TiB, or bare K/M/G/T) are powers of
+    1024; decimal ones (KB/MB/GB/TB) powers of 1000.
+    """
+    if isinstance(s, (int, float)):
+        return int(s)
+    text = str(s).strip()
+    i = len(text)
+    while i > 0 and not (text[i - 1].isdigit() or text[i - 1] == "."):
+        i -= 1
+    num, unit = text[:i].strip(), text[i:].strip().upper()
+    if not num or unit not in _SIZE_UNITS:
+        raise ValueError(
+            f"cannot parse size {s!r} — expected e.g. '16GiB', '32GB', "
+            "or a plain byte count")
+    return int(float(num) * _SIZE_UNITS[unit])
+
+
 def detect(devices: Sequence[jax.Device] | None = None) -> Topology:
     """Discover the visible device topology.
 

@@ -77,10 +77,17 @@ class TrainerConfig:
     # large models) set this to 1-2.  Single-process runs check the
     # local flag every step regardless.
     preempt_check_every: int = 8
-    # static plan/graph lint (analysis.preflight) before step 0 —
-    # trace-only, no extra compile (BENCH_NOTES)
+    # static plan/graph/mem/dtype lint (analysis.preflight) before
+    # step 0 — trace-only, no extra compile (BENCH_NOTES)
     preflight: bool = True
     preflight_action: str = "warn"  # 'warn' | 'raise'
+    # HBM budget for the memory lint ('16GiB' or bytes); None -> the
+    # detected chip's ChipSpec.  With preflight_action='raise', a
+    # predicted OOM (ML001) aborts before step 0 instead of at it.
+    preflight_budget: "int | str | None" = None
+    # rule codes to suppress (analysis.filter_ignored) — the
+    # plan/graph/mem/dtype analog of '# tadnn: lint-ok(CODE)'
+    preflight_ignore: "tuple[str, ...]" = ()
 
 
 def _is_step_indexed(data: Any) -> bool:
@@ -187,16 +194,22 @@ class Trainer:
                     self.metrics.close()
 
     def _preflight(self, batch: Any, rng: "jax.Array | None" = None) -> None:
-        """Static plan + graph lint against the built plan and a
-        re-trace of the step fn (``analysis.preflight``) — trace-only,
-        nothing is compiled or executed.  ``preflight_action='warn'``
-        prints findings and continues; ``'raise'`` escalates
-        error-severity findings to :class:`analysis.PreflightError`.
-        A crash in the analyzer itself never blocks training."""
+        """Static plan + graph + memory + dtype lint against the built
+        plan and a re-trace of the step fn (``analysis.preflight``) —
+        trace-only, nothing is compiled or executed.
+        ``preflight_action='warn'`` prints findings and continues;
+        ``'raise'`` escalates error-severity findings (including a
+        predicted OOM against ``preflight_budget``) to
+        :class:`analysis.PreflightError`.  A crash in the analyzer
+        itself never blocks training."""
         from .. import analysis
 
         try:
-            findings = analysis.preflight(self.ad, batch, rng=rng)
+            findings = analysis.preflight(
+                self.ad, batch, rng=rng,
+                budget=self.cfg.preflight_budget,
+                ignore=self.cfg.preflight_ignore,
+            )
         except Exception as e:
             obs_journal.event("lint.skipped", phase="preflight",
                               error=f"{type(e).__name__}: {e}")

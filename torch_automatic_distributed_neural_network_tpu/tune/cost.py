@@ -99,12 +99,14 @@ def score(
     grad_dtype: Any = np.float32,
     flops_total: float | None = None,
     safety: float | None = None,
+    act_profile: dict | None = None,
 ) -> CostEstimate:
     """Roofline step-time estimate for one candidate.
 
     ``flops_total`` overrides the analytic 6*P*N FLOPs estimate with a
     measured one (``utils.profiling.compiled_cost``) when the caller
-    has compiled the real step.
+    has compiled the real step; ``act_profile`` swaps the activation
+    heuristic for the liveness profile (``space.candidate_memory``).
     """
     chip = topo.chip
     degrees = cand.full_degrees()
@@ -163,6 +165,7 @@ def score(
     mem = candidate_memory(
         abstract_params, cand, state_factor=state_factor,
         batch_items=items, rules=rules, remat=remat,
+        act_profile=act_profile,
     )
     # fwd+bwd read params twice, optimizer reads+writes state once each
     hbm_traffic = (4.0 * mem["param_bytes"] + 2.0 * mem["state_bytes"]

@@ -133,6 +133,30 @@ def activation_bytes(
     return int(est * (REMAT_ACT_FACTOR if remat else 1.0))
 
 
+def _profiled_activation_bytes(
+    act_profile: dict,
+    items_per_device: float,
+    *,
+    remat: bool,
+    param_frac: float,
+) -> int:
+    """Per-device transient bytes from a liveness profile
+    (``analysis.mem_lint`` via ``AutoDistribute.activation_profile``):
+    the traced batch-proportional term rescales linearly to this
+    candidate's items/device, param-shaped transients (grads, optimizer
+    temporaries) scale with the candidate's average param shard
+    fraction, the remainder is charged in full."""
+    key = "remat" if (remat and act_profile.get("remat")) else "noremat"
+    prof = act_profile.get(key) or act_profile.get("noremat") or {}
+    n0 = max(1, int(act_profile.get("batch_items") or 1))
+    est = (
+        prof.get("batch_bytes", 0) * (items_per_device / n0)
+        + prof.get("param_like_bytes", 0) * param_frac
+        + prof.get("other_bytes", 0)
+    )
+    return int(est)
+
+
 def candidate_memory(
     abstract_params: Any,
     cand: Candidate,
@@ -141,10 +165,17 @@ def candidate_memory(
     batch_items: int | None = None,
     rules: Sequence[planner.Rule] = planner.TRANSFORMER_RULES,
     remat: bool = True,
+    act_profile: dict | None = None,
 ) -> dict:
     """Per-device memory estimate for a candidate, via the planner's own
     spec assignment (replicated-because-indivisible dims are charged in
-    full, exactly as GSPMD would lay them out)."""
+    full, exactly as GSPMD would lay them out).
+
+    With ``act_profile`` (a liveness profile of the *real* traced step)
+    the activation term comes from measured liveness intervals rescaled
+    to this candidate; without it, from the coarse param-count
+    heuristic (:func:`activation_bytes`).
+    """
     degrees = cand.full_degrees()
     specs = planner.param_spec_tree(
         abstract_params, degrees, cand.strategy, rules
@@ -152,10 +183,12 @@ def candidate_memory(
     spec_leaves = jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P))
     leaves = jax.tree.leaves(abstract_params)
     param_b = 0.0
+    total_b = 0.0
     for spec, leaf in zip(spec_leaves, leaves):
         shape = tuple(getattr(leaf, "shape", ()))
         itemsize = np.dtype(getattr(leaf, "dtype", np.float32)).itemsize
         nbytes = (math.prod(shape) if shape else 1) * itemsize
+        total_b += nbytes
         frac = 1
         for ax in planner.spec_axes(spec):
             frac *= degrees.get(ax, 1)
@@ -166,12 +199,18 @@ def candidate_memory(
     )
     items = (batch_items or DEFAULT_BATCH_ITEMS) / max(1, batch_deg)
     items /= max(1, cand.grad_accum)
-    act_b = activation_bytes(abstract_params, items, remat=remat)
+    if act_profile:
+        act_b = _profiled_activation_bytes(
+            act_profile, items, remat=remat,
+            param_frac=param_b / max(1.0, total_b))
+    else:
+        act_b = activation_bytes(abstract_params, items, remat=remat)
     return {
         "param_bytes": int(param_b),
         "state_bytes": int(state_b),
         "activation_bytes": int(act_b),
         "total_bytes": int(state_b + act_b),
+        "profiled": bool(act_profile),
     }
 
 
@@ -189,6 +228,7 @@ def enumerate_candidates(
     state_factor: float = 4.0,
     batch_items: int | None = None,
     safety: float = MEMORY_SAFETY,
+    act_profile: dict | None = None,
 ) -> tuple[list[Candidate], list[tuple[Candidate, str]]]:
     """(kept, pruned) candidates for this model on this topology.
 
@@ -232,13 +272,15 @@ def enumerate_candidates(
             mem = candidate_memory(
                 abstract_params, cand, state_factor=state_factor,
                 batch_items=batch_items, rules=rules,
+                act_profile=act_profile,
             )
             if mem["total_bytes"] > budget:
+                kind = "liveness" if act_profile else "heuristic"
                 pruned.append((cand, (
                     f"memory: ~{mem['total_bytes'] / 2**30:.2f} GiB "
                     f"(state {mem['state_bytes'] / 2**30:.2f} + act "
-                    f"{mem['activation_bytes'] / 2**30:.2f}) > budget "
-                    f"{budget / 2**30:.2f} GiB")))
+                    f"{mem['activation_bytes'] / 2**30:.2f}, {kind}) "
+                    f"> budget {budget / 2**30:.2f} GiB")))
             else:
                 kept.append(cand)
     return kept, pruned

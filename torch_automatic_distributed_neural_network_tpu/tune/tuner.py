@@ -42,6 +42,13 @@ class TunePolicy:
     safety: float = space_mod.MEMORY_SAFETY
     top_k: int = 3
     use_cache: bool = True
+    # Liveness activation profile of the real traced step
+    # (AutoDistribute.activation_profile / analysis.mem_lint) — swaps
+    # the coarse activation heuristic for measured liveness intervals
+    # in memory pruning and ranking.  A plain JSON-able dict, so it
+    # hashes into the cache key like every other knob: a changed model
+    # graph re-tunes.
+    act_profile: Any = None
 
 
 @dataclasses.dataclass
@@ -94,7 +101,7 @@ def tune(
         abstract_params, topo, rules=rules,
         grad_accums=policy.grad_accums, max_tensor=policy.max_tensor,
         state_factor=policy.state_factor, batch_items=policy.batch_items,
-        safety=policy.safety,
+        safety=policy.safety, act_profile=policy.act_profile,
     )
     if topo.num_devices == 1 or len(kept) <= 1:
         # Degenerate space (single chip, or pruning left at most one
@@ -116,7 +123,7 @@ def tune(
     ranked = cost_mod.rank(
         abstract_params, topo, kept, rules=rules,
         state_factor=policy.state_factor, batch_items=policy.batch_items,
-        safety=policy.safety,
+        safety=policy.safety, act_profile=policy.act_profile,
     )
     for i, est in enumerate(ranked[:8]):
         obs_journal.event("tune.candidate", rank=i, **est.to_json())
