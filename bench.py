@@ -56,6 +56,11 @@ def _save_last_good(mode: str, result: dict, device_kind: str) -> None:
         ).strftime("%Y-%m-%dT%H:%M:%SZ"),
         "device_kind": device_kind,
     }
+    # which driver round produced the number (TADNN_BENCH_ROUND=r06...),
+    # so a later tunnel-down round's stale marker can say stale_of=r06
+    rnd = os.environ.get("TADNN_BENCH_ROUND")
+    if rnd:
+        data[mode]["round"] = rnd
     tmp = LAST_GOOD_PATH + ".tmp"
     with open(tmp, "w") as f:
         json.dump(data, f, indent=1, sort_keys=True)
@@ -1279,33 +1284,50 @@ def _main_probed(args, err, jnl):
             _cpu_sim_reexec(cpu_ok[args["mode"]],
                             f"TPU backend unreachable ({err}); "
                             f"mode={args['mode']} runs on the CPU sim")
-        # The metric is unmeasurable THIS run.  Emit the most recent
-        # committed TPU measurement for this mode, explicitly labeled
-        # stale, so the driver scoreboard reflects the framework rather
-        # than the tunnel; 0.0 only when no committed number exists.
+        # The metric is unmeasurable THIS run.  NEVER re-emit a previous
+        # round's value as this round's number — the r03-r05 failure
+        # mode: a replayed headline reads as a fresh measurement on the
+        # driver scoreboard and hides a dead tunnel for rounds.  Emit an
+        # explicit backend_unreachable record that POINTS at the last
+        # good measurement (value 0.0, metric renamed) so nothing
+        # downstream can mistake it for data; `tadnn report --check`
+        # exits nonzero on it.
         log(f"TPU backend unreachable: {err}")
         last = (_load_last_good().get(args["mode"])
                 if _canonical_argv(args["mode"]) else None)
         if last:
-            rec = dict(last["result"])
-            extra = dict(rec.get("extra") or {})
-            extra.update({
-                "stale": True,
-                "measured_utc": last["measured_utc"],
-                "device_kind": last.get("device_kind", ""),
-                "probe_error": err,
-                "note": ("TPU tunnel down at bench time; value is the "
-                         "most recent committed on-TPU measurement for "
-                         "this mode (BENCH_NOTES.md has the full log)"),
-            })
-            rec["extra"] = extra
-            rec["stale"] = True
+            lg = last.get("result") or {}
+            stale_of = last.get("round") or last.get("measured_utc")
             jnl.event("bench.stale", mode=args["mode"], stale=True,
                       probe_error=err, measured_utc=last["measured_utc"],
-                      metric=rec.get("metric"))
-            log(f"emitting last committed TPU result "
-                f"(measured {last['measured_utc']})")
-            print(json.dumps(rec), flush=True)
+                      stale_of=stale_of, metric=lg.get("metric"))
+            log(f"NOT re-emitting last committed TPU result "
+                f"(measured {last['measured_utc']}); marking the round "
+                f"unmeasurable instead")
+            print(json.dumps({
+                "metric": f"{args['mode']}_backend_unreachable",
+                "value": 0.0,
+                "unit": "none",
+                "vs_baseline": 0.0,
+                "status": "backend_unreachable",
+                "stale": True,
+                "stale_of": stale_of,
+                "extra": {
+                    "probe_error": err,
+                    "mode": args["mode"],
+                    "last_good": {
+                        "metric": lg.get("metric"),
+                        "value": lg.get("value"),
+                        "unit": lg.get("unit"),
+                        "measured_utc": last["measured_utc"],
+                        "device_kind": last.get("device_kind", ""),
+                    },
+                    "note": ("TPU tunnel down at bench time; this round "
+                             "measured NOTHING — last_good is the most "
+                             "recent committed on-TPU number, shown for "
+                             "reference only (BENCH_NOTES.md)"),
+                },
+            }), flush=True)
             return
         jnl.event("bench.unmeasurable", mode=args["mode"], ok=False,
                   probe_error=err)
@@ -1314,6 +1336,7 @@ def _main_probed(args, err, jnl):
             "value": 0.0,
             "unit": "none",
             "vs_baseline": 0.0,
+            "status": "backend_unreachable",
             "extra": {"error": err, "mode": args["mode"],
                       "note": ("TPU tunnel was down at bench time and no "
                                "committed TPU measurement exists for this "

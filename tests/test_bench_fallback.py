@@ -1,9 +1,12 @@
-"""bench.py tunnel-down behavior: stale last-good fallback (VERDICT r4 #2).
+"""bench.py tunnel-down behavior: never replay a stale number.
 
-When the TPU probe fails, the driver artifact must carry the most recent
-committed on-TPU number for the requested mode — explicitly labeled
-stale — and 0.0 only when no such number exists.  r03/r04 both scored
-0.0 while committed measurements existed; these tests pin the fix.
+When the TPU probe fails the round measured NOTHING, and the driver
+artifact must say so: a ``status: backend_unreachable`` record with
+value 0.0 that *points at* the last committed measurement (``stale_of``)
+instead of re-emitting its value.  The r03-r05 incident was exactly a
+replayed headline reading as fresh data on the scoreboard; these tests
+pin the new contract, and ``tadnn report --check`` (test_trace.py)
+enforces it downstream.
 """
 
 import json
@@ -29,7 +32,7 @@ def _run_main(monkeypatch, capsys, argv=("bench.py",)):
     return json.loads(out[-1])
 
 
-def test_stale_fallback_emits_last_good(last_good, monkeypatch, capsys):
+def test_unreachable_never_reemits_last_good(last_good, monkeypatch, capsys):
     measured = {
         "metric": "gpt2_1p3b_tokens_per_sec_per_chip",
         "value": 15354.9, "unit": "tokens/s/chip", "vs_baseline": 1.5352,
@@ -38,47 +41,67 @@ def test_stale_fallback_emits_last_good(last_good, monkeypatch, capsys):
     last_good.write_text(json.dumps({
         "gpt2": {"result": measured,
                  "measured_utc": "2026-07-31T01:04:15Z",
+                 "device_kind": "TPU v5 lite",
+                 "round": "r02"},
+    }))
+    rec = _run_main(monkeypatch, capsys)
+    # the headline value must NOT come back as this round's number
+    assert rec["value"] == 0.0
+    assert rec["status"] == "backend_unreachable"
+    assert rec["stale"] is True
+    assert rec["stale_of"] == "r02"
+    assert rec["metric"] == "gpt2_backend_unreachable"
+    # ...but the pointer to the real measurement survives for reference
+    lg = rec["extra"]["last_good"]
+    assert lg["value"] == pytest.approx(15354.9)
+    assert lg["metric"] == "gpt2_1p3b_tokens_per_sec_per_chip"
+    assert lg["measured_utc"] == "2026-07-31T01:04:15Z"
+    assert "tunnel down (test)" in rec["extra"]["probe_error"]
+
+
+def test_stale_of_falls_back_to_measured_utc(last_good, monkeypatch, capsys):
+    # entries saved before round labels existed still get a pointer
+    last_good.write_text(json.dumps({
+        "gpt2": {"result": {"metric": "m", "value": 1.0, "unit": "u",
+                            "vs_baseline": 0.0, "extra": {}},
+                 "measured_utc": "2026-07-31T01:04:15Z",
                  "device_kind": "TPU v5 lite"},
     }))
     rec = _run_main(monkeypatch, capsys)
-    assert rec["value"] == pytest.approx(15354.9)
-    assert rec["vs_baseline"] == pytest.approx(1.5352)
-    assert rec["stale"] is True
-    assert rec["extra"]["stale"] is True
-    assert rec["extra"]["measured_utc"] == "2026-07-31T01:04:15Z"
-    assert "tunnel down (test)" in rec["extra"]["probe_error"]
-    # the metric name stays the measured one so scoreboards track it
-    assert rec["metric"] == "gpt2_1p3b_tokens_per_sec_per_chip"
+    assert rec["stale_of"] == "2026-07-31T01:04:15Z"
 
 
 def test_no_last_good_emits_zero(last_good, monkeypatch, capsys):
     rec = _run_main(monkeypatch, capsys)
     assert rec["value"] == 0.0
     assert rec["metric"] == "gpt2_unmeasurable_backend_down"
+    assert rec["status"] == "backend_unreachable"
     assert "no committed TPU measurement" in rec["extra"]["note"]
 
 
-def test_save_last_good_roundtrip(last_good):
+def test_save_last_good_roundtrip(last_good, monkeypatch):
+    monkeypatch.setenv("TADNN_BENCH_ROUND", "r06")
     bench._save_last_good(
         "gpt2", {"metric": "m", "value": 1.0}, "TPU v5 lite")
     data = bench._load_last_good()
     assert data["gpt2"]["result"]["value"] == 1.0
     assert data["gpt2"]["device_kind"] == "TPU v5 lite"
     assert data["gpt2"]["measured_utc"].endswith("Z")
+    assert data["gpt2"]["round"] == "r06"
 
 
 def test_repo_last_good_is_seeded():
     # The committed file must carry the headline mode so a tunnel-down
-    # round never scores 0.0 again.
+    # round has a real measurement to point at (stale_of).
     data = bench._load_last_good()
     assert "gpt2" in data
     assert data["gpt2"]["result"]["value"] > 0
 
-def test_noncanonical_argv_never_replays_last_good(
+def test_noncanonical_argv_has_no_stale_pointer(
         last_good, monkeypatch, capsys):
-    # `mode=attention sweep=1` must not be answered with the committed
-    # HEADLINE attention record — the caller asked for a different
-    # metric (round-5 review)
+    # `mode=attention sweep=1` asked for a different metric than the
+    # committed HEADLINE attention record, so the unreachable record
+    # must not even point at it (round-5 review)
     last_good.write_text(json.dumps({
         "attention": {"result": {"metric": "flash_attention_speedup",
                                  "value": 14.22, "unit": "x",
@@ -92,10 +115,11 @@ def test_noncanonical_argv_never_replays_last_good(
     assert rec["metric"] == "attention_unmeasurable_backend_down"
 
 
-def test_canonical_extra_allows_decode_moe(last_good, monkeypatch, capsys):
+def test_canonical_extra_decode_moe_marks_stale(last_good, monkeypatch,
+                                                capsys):
     # decode's headline IS the MoE-routed capture: `mode=decode
-    # model=moe` counts as canonical for both save and replay, and wins
-    # over the CPU-sim re-exec when a committed TPU number exists
+    # model=moe` is canonical, so the unreachable record points at the
+    # committed number — without replaying its value
     last_good.write_text(json.dumps({
         "decode": {"result": {"metric": "moe_small_decode_tokens_per_s",
                               "value": 1651.8, "unit": "tokens/s",
@@ -105,8 +129,10 @@ def test_canonical_extra_allows_decode_moe(last_good, monkeypatch, capsys):
     }))
     rec = _run_main(monkeypatch, capsys,
                     argv=["bench.py", "mode=decode", "model=moe"])
-    assert rec["value"] == pytest.approx(1651.8)
+    assert rec["value"] == 0.0
+    assert rec["status"] == "backend_unreachable"
     assert rec["stale"] is True
+    assert rec["extra"]["last_good"]["value"] == pytest.approx(1651.8)
 
 
 def test_bad_sweep_seqs_is_loud():
@@ -118,9 +144,9 @@ def test_bad_sweep_seqs_is_loud():
 
 def test_dense_decode_does_not_share_moe_slot(last_good, monkeypatch, capsys):
     # extras are REQUIRED, not merely permitted: plain dense `mode=decode`
-    # is NOT decode's canonical invocation, so it must not replay (or
-    # ever save over) the MoE-routed headline slot — it falls through to
-    # the CPU-sim re-exec instead (round-5 review, second pass)
+    # is NOT decode's canonical invocation, so it must not mark itself
+    # stale-of (or ever save over) the MoE-routed headline slot — it
+    # falls through to the CPU-sim re-exec instead (round-5 review)
     monkeypatch.setattr(bench.sys, "argv", ["bench.py", "mode=decode"])
     assert not bench._canonical_argv("decode")
     monkeypatch.setattr(

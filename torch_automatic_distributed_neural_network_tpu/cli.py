@@ -329,12 +329,123 @@ def _mesh_degrees_or_none(ad):
             if ad.plan is not None else None)
 
 
+def cmd_trace(args: argparse.Namespace) -> int:
+    """Profile real steps on the live backend: a device-timeline capture
+    (obs/trace) attributed into per-step compute / collective / exposed
+    collective time and measured MFU, plus the measured-vs-modeled
+    collective-bytes crosscheck (compiled HLO vs
+    planner.expected_collective_bytes).
+
+    Two modes: a model-zoo config (--family et al., default the bench
+    mlp) traced in-process, or a training script (``tadnn trace
+    train.py``) run with TADNN_TRACE_EVERY_N exported so the Trainer
+    instruments itself every Nth step.
+    """
+    if args.target and args.target.endswith(".py"):
+        os.environ.setdefault("TADNN_TRACE_EVERY_N", str(args.every))
+        if args.journal:
+            os.environ.setdefault("TADNN_JOURNAL", args.journal)
+        _maybe_init_distributed()
+        return _run_script(args.target, args.script_args)
+    if args.target:
+        print(f"trace target must be a .py script (got {args.target}); "
+              "omit it to trace a --family config", file=sys.stderr)
+        return 2
+
+    import jax
+    import optax
+
+    from . import AutoDistribute
+    from .obs import Journal, set_default
+    from .obs import comms as obs_comms
+    from .obs import trace as obs_trace
+    from .training.metrics import transformer_step_flops
+
+    jnl = Journal(args.journal)  # path=None -> in-memory sink
+    set_default(jnl)
+    model, loss, sample = _family_setup(args)
+    ad = AutoDistribute(model, optimizer=optax.adamw(1e-4), loss_fn=loss,
+                        strategy=args.strategy, precision=args.precision)
+    rng = jax.random.key(0)
+    state = ad.init(rng, sample)
+    n_params = sum(x.size for x in jax.tree.leaves(state.params))
+    tokens = args.batch * ((args.seq or 1024)
+                           if args.family in ("gpt2", "llama", "moe", "bert")
+                           else 1)
+    flops = transformer_step_flops(n_params, tokens)
+
+    # warm the compile outside the capture — the first dispatch would
+    # profile XLA, not the step
+    state, m = ad.step(state, sample)
+    jax.block_until_ready(m)
+    state, recs = obs_trace.trace_steps(
+        ad.step, state, sample, steps=args.steps, first_step=1,
+        logdir=args.logdir, flops_per_step=flops, journal=jnl,
+    )
+    measured = obs_trace.measured_collective_bytes(ad, rng, sample)
+    est = obs_comms.comm_profile(ad, rng, sample)
+    xc = obs_trace.crosscheck_collectives(
+        measured, est.get("per_device") or {},
+        grad_accum=ad._grad_accum, journal=jnl,
+    )
+    jnl.close()
+
+    if args.json:
+        for r in recs:
+            print(json.dumps(r))
+        for c in xc:
+            print(json.dumps(c))
+        return 0
+    print(f"traced {len(recs)} step(s) on {jax.device_count()} x "
+          f"{jax.devices()[0].device_kind}  (strategy "
+          f"{ad.plan.strategy}, {n_params:,} params)")
+    for r in recs:
+        line = (f"  step {r['step']}: wall {r['wall_s'] * 1e3:8.2f}ms  "
+                f"compute {r['compute_s'] * 1e3:8.2f}ms  "
+                f"collective {r['collective_s'] * 1e3:7.2f}ms  "
+                f"exposed {r['exposed_collective_s'] * 1e3:7.2f}ms")
+        if r.get("measured_mfu") is not None:
+            line += f"  mfu {r['measured_mfu']:.2%}"
+        print(line)
+    frac = obs_trace.exposed_fraction(recs)
+    if frac is not None:
+        print(f"exposed collective fraction: {frac:.1%} "
+              "(communication the schedule failed to hide)")
+    for c in xc:
+        print(f"  {c['category']}: measured {c['measured_bytes']:,} B "
+              f"vs modeled {c['modeled_bytes']:,} B  "
+              f"ratio {c['ratio']}"
+              + ("" if c["within_2x"] else "  !! outside 2x band"))
+    if args.journal:
+        print(f"journal written to {args.journal} (render with "
+              f"`tadnn report {args.journal}`)")
+    return 0
+
+
 def cmd_report(args: argparse.Namespace) -> int:
     """Summarize a finished (or crashed) run from its on-disk artifacts:
     journal JSONL + MetricsLogger JSONL.  Pure file parsing — no jax
-    import, so it works on a machine with no accelerator runtime."""
+    import, so it works on a machine with no accelerator runtime.
+    ``--check`` instead runs the bench freshness guard; ``--merge``
+    joins per-host journals first (obs/aggregate)."""
     from .obs import report as obs_report
 
+    if args.check:
+        code, msgs = obs_report.check_bench(
+            args.target, bench_path=args.bench,
+            last_good_path=args.last_good)
+        for m in msgs:
+            print(("FAIL " if code else "ok   ") + m)
+        return code
+    if args.merge:
+        from .obs import aggregate
+
+        try:
+            merged = aggregate.merge_run(args.target)
+            print(f"merged per-host journals -> {merged}")
+        except (FileNotFoundError, NotADirectoryError, OSError) as e:
+            print(f"--merge: {e}", file=sys.stderr)
+            return 1
     rep = obs_report.generate(args.target, args.metrics)
     if args.json:
         print(json.dumps(rep))
@@ -571,17 +682,75 @@ def main(argv: list[str] | None = None) -> int:
     p.set_defaults(fn=cmd_tune)
 
     p = sub.add_parser(
+        "trace",
+        help="profile real steps: device-timeline capture with per-step "
+             "compute/collective/exposed attribution + measured MFU, "
+             "and a measured-vs-modeled collective-bytes crosscheck; "
+             "pass a .py script to run it with TADNN_TRACE_EVERY_N "
+             "exported",
+    )
+    p.add_argument("target", nargs="?", default=None,
+                   help="training script to instrument (script mode); "
+                        "omit to trace a --family config in-process. "
+                        "trace options go BEFORE the script; everything "
+                        "after it is passed to the script: "
+                        "tadnn trace --every 8 train.py -- --steps 100")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    p.add_argument("--steps", type=int, default=3,
+                   help="instrumented steps to capture (config mode)")
+    p.add_argument("--every", type=int, default=10,
+                   help="script mode: trace every Nth step "
+                        "(TADNN_TRACE_EVERY_N)")
+    p.add_argument("--logdir", default=None,
+                   help="profiler logdir (default: a fresh temp dir)")
+    p.add_argument("--journal", default=None,
+                   help="journal JSONL to write trace.step / "
+                        "trace.collective events to")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--family", default="mlp",
+                   choices=("mlp", "gpt2", "llama", "moe", "bert", "vit"),
+                   help="model to trace in config mode (default: the "
+                        "bench mlp)")
+    p.add_argument("--size", default=None,
+                   help="model size preset; for mlp, comma-separated "
+                        "layer widths (default 1024,1024,10)")
+    p.add_argument("--seq", type=int, default=None,
+                   help="sequence length; for mlp/vit, the input image "
+                        "side")
+    p.add_argument("--batch", type=int, default=256)
+    p.add_argument("--strategy", default="dp",
+                   help="sharding strategy (default dp — the bench "
+                        "config, which has collectives on >1 device)")
+    p.add_argument("--precision", default="fp32")
+    p.set_defaults(fn=cmd_trace)
+
+    p = sub.add_parser(
         "report",
         help="summarize a run's journal + metrics JSONL: compiles/"
-             "recompiles, goodput breakdown, expected comm bytes, "
-             "incidents (works offline; no accelerator needed)",
+             "recompiles, goodput breakdown, expected + measured comm "
+             "bytes, trace attribution, incidents (works offline; no "
+             "accelerator needed)",
     )
     p.add_argument("target",
-                   help="run directory (searched for journal.jsonl / "
-                        "metrics.jsonl) or a journal file path")
+                   help="run directory (searched for journal.merged."
+                        "jsonl / journal.jsonl / metrics.jsonl) or a "
+                        "journal file path")
     p.add_argument("--metrics", default=None,
                    help="explicit MetricsLogger JSONL path")
     p.add_argument("--json", action="store_true")
+    p.add_argument("--check", action="store_true",
+                   help="bench freshness guard: exit nonzero when the "
+                        "latest BENCH_r*.json is stale-marked/missing "
+                        "or its headline regressed >10%% vs "
+                        "BENCH_LAST_GOOD.json")
+    p.add_argument("--bench", default=None,
+                   help="explicit bench record path for --check "
+                        "(default: newest BENCH_r*.json in target)")
+    p.add_argument("--last-good", default=None, dest="last_good",
+                   help="explicit BENCH_LAST_GOOD.json path for --check")
+    p.add_argument("--merge", action="store_true",
+                   help="merge per-host journals in the target directory "
+                        "into journal.merged.jsonl before reporting")
     p.set_defaults(fn=cmd_report)
 
     p = sub.add_parser(

@@ -596,6 +596,47 @@ class AutoDistribute:
 
         return make_state
 
+    def _abstract_step_args(self, rng: jax.Array, sample_batch: Any):
+        """Sharding-annotated abstract ``(state, batch)`` for the compiled
+        step — the AOT lowering inputs shared by ``compile_report`` and
+        ``compiled_step_text``.  Builds the plan and compiles the step fn
+        if neither has happened yet."""
+        if self.plan is None:
+            self.build_plan(rng, sample_batch)
+        self._check_batch(sample_batch)
+        abstract = jax.eval_shape(self._make_state_fn(sample_batch), rng)
+        shardings = self.state_shardings(abstract)
+        if self._step_fn is None:
+            self._compile_step(abstract, shardings)
+
+        def sds(a, s):
+            return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s)
+
+        state_abs = jax.tree.map(sds, abstract, shardings)
+        batch_abs = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
+            sample_batch,
+        )
+        return state_abs, batch_abs
+
+    def compiled_step_text(self, rng: jax.Array,
+                           sample_batch: Any) -> str | None:
+        """Optimized HLO text of the compiled per-device train step.
+
+        This is the ground truth the tracing layer greps for collective
+        ops (``obs.trace.hlo_collective_bytes``): the payload bytes XLA
+        actually moves per step, to cross-check against the planner's
+        ``expected_collective_bytes`` model.  AOT from abstract shapes —
+        nothing is materialized.  None when the backend can't lower or
+        render (measured-vs-modeled is then simply unavailable).
+        """
+        state_abs, batch_abs = self._abstract_step_args(rng, sample_batch)
+        try:
+            return self._step_fn.lower(state_abs, batch_abs) \
+                .compile().as_text()
+        except Exception:
+            return None
+
     def compile_report(self, rng: jax.Array, sample_batch: Any) -> dict | None:
         """AOT-compile the full sharded train step from ABSTRACT shapes only
         — no parameters, optimizer state, or activations are ever
@@ -615,22 +656,7 @@ class AutoDistribute:
         ``temp_size`` includes every activation/residual XLA keeps across
         the step at its chosen schedule.
         """
-        if self.plan is None:
-            self.build_plan(rng, sample_batch)
-        self._check_batch(sample_batch)
-        abstract = jax.eval_shape(self._make_state_fn(sample_batch), rng)
-        shardings = self.state_shardings(abstract)
-        if self._step_fn is None:
-            self._compile_step(abstract, shardings)
-
-        def sds(a, s):
-            return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s)
-
-        state_abs = jax.tree.map(sds, abstract, shardings)
-        batch_abs = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
-            sample_batch,
-        )
+        state_abs, batch_abs = self._abstract_step_args(rng, sample_batch)
         from .utils.profiling import compiled_cost
 
         cost = compiled_cost(self._step_fn, state_abs, batch_abs)

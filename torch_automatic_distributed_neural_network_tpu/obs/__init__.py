@@ -7,6 +7,7 @@ a process-global journal (``set_default`` / ``TADNN_JOURNAL`` env); when
 none is installed every call is a cheap no-op.
 """
 
+from . import aggregate, trace
 from .goodput import BUCKETS, GoodputMeter
 from .journal import (
     Journal,
@@ -21,9 +22,11 @@ __all__ = [
     "BUCKETS",
     "GoodputMeter",
     "Journal",
+    "aggregate",
     "as_default",
     "event",
     "get_default",
     "set_default",
     "span",
+    "trace",
 ]

@@ -7,6 +7,9 @@ Buckets (the TorchTitan-style breakdown, PAPERS.md):
 - ``step``         steady-state training-step host time (the goodput)
 - ``checkpoint``   save/restore + async-commit waits
 - ``eval``         periodic evaluation passes
+- ``trace``        profiler-instrumented steps (TrainerConfig.
+                   trace_every_n, obs/trace.py) — fenced and captured,
+                   so their wall time is overhead, not goodput
 - ``input_stall``  waiting on the data source for the next batch
 - ``idle``         everything unaccounted (guards, logging, callbacks,
                    host-side bookkeeping) — computed as the remainder
@@ -21,7 +24,8 @@ import contextlib
 import time
 from typing import Iterator
 
-BUCKETS = ("compile", "step", "checkpoint", "eval", "input_stall", "idle")
+BUCKETS = ("compile", "step", "checkpoint", "eval", "trace",
+           "input_stall", "idle")
 
 
 class GoodputMeter:

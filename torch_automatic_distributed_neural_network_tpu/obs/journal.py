@@ -29,6 +29,7 @@ import contextlib
 import json
 import os
 import time
+import warnings
 from typing import Any, IO, Iterator
 
 
@@ -51,10 +52,17 @@ class Journal:
     ``path=None`` keeps records in memory only (``self.records``) — the
     test/tooling mode.  ``host0_only=True`` (default) makes non-zero
     hosts' journals silent no-ops so multi-host runs produce one file.
+
+    ``max_bytes`` (or ``TADNN_JOURNAL_MAX_BYTES`` in the environment)
+    caps the file: when a write crosses the cap the file rotates to
+    ``<path>.1`` (one generation, overwritten) and the journal keeps
+    appending to a fresh file — a long-running server's journal can
+    never eat the disk.
     """
 
     def __init__(self, path: str | None = None, *,
-                 host0_only: bool = True, meta: dict | None = None):
+                 host0_only: bool = True, meta: dict | None = None,
+                 max_bytes: int | None = None):
         self.path = path
         self.enabled = (not host0_only) or _process_index() == 0
         self._t0 = time.monotonic()
@@ -62,6 +70,14 @@ class Journal:
         self._file: IO | None = None
         self.records: list[dict] = []  # in-memory sink when path is None
         self.counts: dict[str, int] = {}
+        if max_bytes is None:
+            try:
+                max_bytes = int(
+                    os.environ.get("TADNN_JOURNAL_MAX_BYTES", "0")) or None
+            except ValueError:
+                max_bytes = None
+        self._max_bytes = max_bytes
+        self.rotations = 0
         if self.enabled and path:
             d = os.path.dirname(os.path.abspath(path))
             os.makedirs(d, exist_ok=True)
@@ -80,8 +96,34 @@ class Journal:
         if self._file is not None:
             self._file.write(json.dumps(rec, default=str) + "\n")
             self._file.flush()
+            if (self._max_bytes and not getattr(self, "_rotating", False)
+                    and self._file.tell() >= self._max_bytes):
+                self._rotate()
         else:
             self.records.append(rec)
+
+    def _rotate(self) -> None:
+        """Move the full file to ``<path>.1`` (replacing any previous
+        generation) and reopen fresh.  The rotated event lands first in
+        the new file so a reader knows records were shed."""
+        self._file.close()
+        try:
+            os.replace(self.path, self.path + ".1")
+        except OSError:
+            # rotation is best-effort (read-only fs mid-run): keep
+            # appending rather than lose the sink entirely
+            self._file = open(self.path, "a")
+            return
+        self._file = open(self.path, "a")
+        self.rotations += 1
+        # _rotating guards the rotated event's own write: with a cap
+        # smaller than one record it would otherwise recurse forever
+        self._rotating = True
+        try:
+            self.event("journal.rotated", rotations=self.rotations,
+                       max_bytes=self._max_bytes)
+        finally:
+            self._rotating = False
 
     def event(self, name: str, **fields: Any) -> dict | None:
         """One point-in-time record: ``{"kind": "event", "name": ...}``."""
@@ -144,18 +186,43 @@ class Journal:
 
     @staticmethod
     def read(path: str) -> list[dict]:
-        """Parse a journal file, skipping torn/partial lines."""
+        """Parse a journal file, skipping torn/partial JSONL lines.
+
+        A crashed writer leaves a torn final line; a concurrent writer
+        can be seen mid-record.  Neither may take down ``tadnn report``,
+        so bad lines are skipped — with ONE warning per file (not one
+        per line, not silence: a silently-shrinking journal is the
+        observability failure mode this layer exists to prevent).
+        Non-dict JSON lines (bare numbers/strings) are torn too.
+        """
         out: list[dict] = []
+        bad = 0
         with open(path) as f:
             for line in f:
                 line = line.strip()
                 if not line:
                     continue
                 try:
-                    out.append(json.loads(line))
+                    rec = json.loads(line)
                 except ValueError:
+                    bad += 1
                     continue
+                if isinstance(rec, dict):
+                    out.append(rec)
+                else:
+                    bad += 1
+        if bad and path not in _warned_corrupt:
+            _warned_corrupt.add(path)
+            warnings.warn(
+                f"journal {path}: skipped {bad} torn/corrupt line(s) "
+                f"({len(out)} readable records kept)",
+                stacklevel=2,
+            )
         return out
+
+
+# paths already warned about corrupt lines (once-per-file, process-wide)
+_warned_corrupt: set[str] = set()
 
 
 class _NullJournal(Journal):

@@ -17,7 +17,9 @@ from typing import Any
 
 from .journal import Journal
 
-JOURNAL_NAMES = ("journal.jsonl", "events.jsonl")
+# merged-first: a multihost run's aggregate view (obs/aggregate.py)
+# carries host tags the per-host files lack
+JOURNAL_NAMES = ("journal.merged.jsonl", "journal.jsonl", "events.jsonl")
 METRICS_NAMES = ("metrics.jsonl",)
 
 
@@ -156,6 +158,50 @@ def generate(target: str, metrics_path: str | None = None) -> dict:
             k: cross.get(k)
             for k in ("expected_wire_bytes", "xla_bytes_accessed",
                       "comm_fraction_of_bytes_accessed", "consistent")}
+    tsteps = [e for e in events if e.get("name") == "trace.step"]
+    if tsteps:
+        coll = sum(_finite(e.get("collective_s") for e in tsteps))
+        exp = sum(_finite(e.get("exposed_collective_s") for e in tsteps))
+        wall = sum(_finite(e.get("wall_s") for e in tsteps))
+        trace: dict[str, Any] = {
+            "n_steps": len(tsteps),
+            "mean_wall_s": _mean(e.get("wall_s") for e in tsteps),
+            "mean_compute_s": _mean(e.get("compute_s") for e in tsteps),
+            "mean_collective_s": _mean(e.get("collective_s")
+                                       for e in tsteps),
+            "mean_exposed_s": _mean(e.get("exposed_collective_s")
+                                    for e in tsteps),
+            "collective_fraction": (coll / wall) if wall else None,
+            # of the collective time, how much the schedule failed to
+            # hide — the ROADMAP's overlap-push observable
+            "exposed_fraction": (exp / coll) if coll else None,
+            "mean_measured_mfu": _mean(e.get("measured_mfu")
+                                       for e in tsteps
+                                       if e.get("measured_mfu")
+                                       is not None),
+            "mfu_series": [
+                {"step": e.get("step"), "mfu": e["measured_mfu"]}
+                for e in tsteps if e.get("measured_mfu") is not None
+            ][-24:],
+        }
+        report["trace"] = {k: v for k, v in trace.items()
+                          if v not in (None, [])}
+    tcoll = [e for e in events if e.get("name") == "trace.collective"]
+    if tcoll:
+        latest: dict[str, dict] = {}
+        for e in tcoll:  # keep the newest record per category
+            latest[e.get("category", "?")] = e
+        report["trace_collectives"] = [
+            {k: e.get(k) for k in
+             ("category", "hlo_op", "count", "measured_bytes",
+              "modeled_bytes", "ratio", "within_2x")}
+            for e in latest.values()
+        ]
+    from .aggregate import host_skew
+
+    skew = host_skew(events)
+    if skew:
+        report["hosts"] = skew
     probes = [e for e in events
               if str(e.get("name", "")).startswith("bench.")]
     if probes:
@@ -328,7 +374,8 @@ def format_report(report: dict) -> str:
         )
         lines.append("  " + "  ".join(
             f"{b} {fr[b]:.1%}" for b in
-            ("compile", "step", "checkpoint", "eval", "input_stall", "idle")
+            ("compile", "step", "checkpoint", "eval", "trace",
+             "input_stall", "idle")
             if b in fr))
     comms = report.get("comms")
     if comms:
@@ -349,6 +396,49 @@ def format_report(report: dict) -> str:
             f"{cross.get('comm_fraction_of_bytes_accessed') or 0:.1%}"
             + ("" if cross.get("consistent") else
                "  !! estimate exceeds measurement")
+        )
+    trc = report.get("trace")
+    if trc:
+        head = f"trace: {trc['n_steps']} instrumented step(s)"
+        if trc.get("mean_wall_s") is not None:
+            head += f", mean wall {trc['mean_wall_s'] * 1e3:.1f}ms"
+        if trc.get("mean_measured_mfu") is not None:
+            head += f", measured MFU {trc['mean_measured_mfu']:.1%}"
+        lines.append(head)
+        if trc.get("collective_fraction") is not None:
+            exp = trc.get("exposed_fraction")
+            lines.append(
+                f"  collective {trc['collective_fraction']:.1%} of step "
+                f"wall"
+                + (f", exposed {exp:.1%} of collective time"
+                   if exp is not None else "")
+            )
+        series = trc.get("mfu_series")
+        if series and len(series) > 1:
+            lines.append("  mfu over time: " + "  ".join(
+                f"s{p['step']} {p['mfu']:.1%}" for p in series[-8:]))
+    tc = report.get("trace_collectives")
+    if tc:
+        lines.append("exposed-comm crosscheck (measured HLO vs modeled "
+                     "planner bytes, per device/step):")
+        for e in tc:
+            lines.append(
+                f"  {e.get('category'):<20} x{e.get('count', 0)}  "
+                f"measured {_fmt_bytes(e.get('measured_bytes'))}  "
+                f"modeled {_fmt_bytes(e.get('modeled_bytes'))}  "
+                f"ratio {e.get('ratio')}"
+                + ("" if e.get("within_2x") else "  !! outside 2x band")
+            )
+    hosts = report.get("hosts")
+    if hosts:
+        sf = hosts.get("skew_fraction")
+        lines.append(
+            f"hosts: {hosts['n_hosts']}  {hosts.get('event')} "
+            f"{hosts.get('field')} "
+            f"{hosts['fastest'] * 1e3:.1f}..{hosts['slowest'] * 1e3:.1f}ms"
+            + (f"  skew {sf:.1%}" if sf is not None else "")
+            + ("  <- straggler gates every collective"
+               if sf is not None and sf > 0.1 else "")
         )
     inc = report.get("incidents")
     if inc:
@@ -417,3 +507,92 @@ def format_report(report: dict) -> str:
                          f"error={e.get('probe_error')} "
                          f"stale={e.get('stale')}")
     return "\n".join(lines)
+
+
+# -- bench freshness guard (`tadnn report --check`) -------------------------
+
+# how much a headline value may drop vs BENCH_LAST_GOOD before the
+# check fails (the ISSUE's >10% regression gate)
+REGRESSION_TOLERANCE = 0.10
+
+
+def _load_bench_record(path: str) -> dict | None:
+    """One bench record from either bench.py stdout JSON or the driver's
+    round artifact (which wraps it under ``parsed``)."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if isinstance(data, dict) and isinstance(data.get("parsed"), dict):
+        data = data["parsed"]
+    return data if isinstance(data, dict) else None
+
+
+def check_bench(target: str, *, bench_path: str | None = None,
+                last_good_path: str | None = None) -> tuple[int, list[str]]:
+    """The freshness guard behind ``tadnn report --check``.
+
+    Exit-nonzero conditions (each with a message):
+
+    - no bench record found (missing trajectory = the r03-r05 dark run);
+    - the latest record is stale-marked (``status:
+      "backend_unreachable"``, ``stale: true``, or an ``unmeasurable``
+      metric) — the round measured nothing;
+    - the headline value regressed more than
+    ``REGRESSION_TOLERANCE`` vs the committed BENCH_LAST_GOOD entry
+      for the same metric.
+
+    ``target`` is a directory holding ``BENCH_r*.json`` +
+    ``BENCH_LAST_GOOD.json`` (the repo root in CI); explicit paths
+    override discovery.  Returns ``(exit_code, messages)``.
+    """
+    import glob as _glob
+
+    d = target if os.path.isdir(target) else os.path.dirname(
+        os.path.abspath(target)) or "."
+    msgs: list[str] = []
+    if bench_path is None:
+        rounds = sorted(_glob.glob(os.path.join(d, "BENCH_r*.json")))
+        bench_path = rounds[-1] if rounds else None
+    if bench_path is None or not os.path.isfile(bench_path):
+        return 1, ["no bench record (BENCH_r*.json) found — the bench "
+                   "trajectory is dark"]
+    rec = _load_bench_record(bench_path)
+    if rec is None:
+        return 1, [f"{bench_path}: unreadable bench record"]
+    name = os.path.basename(bench_path)
+    metric = str(rec.get("metric", ""))
+    if rec.get("status") == "backend_unreachable" or rec.get("stale"):
+        msgs.append(
+            f"{name}: stale ({rec.get('status') or 'stale-marked'}"
+            + (f", stale_of {rec['stale_of']}" if rec.get("stale_of")
+               else "")
+            + ") — this round measured nothing")
+    elif "unmeasurable" in metric:
+        msgs.append(f"{name}: unmeasurable ({metric})")
+    else:
+        lg_path = last_good_path or os.path.join(d, "BENCH_LAST_GOOD.json")
+        try:
+            with open(lg_path) as f:
+                last_good = json.load(f)
+        except (OSError, ValueError):
+            last_good = {}
+        for mode, entry in last_good.items():
+            res = (entry or {}).get("result") or {}
+            if res.get("metric") != metric or not res.get("value"):
+                continue
+            value = rec.get("value") or 0.0
+            floor = (1.0 - REGRESSION_TOLERANCE) * res["value"]
+            if value < floor:
+                msgs.append(
+                    f"{name}: {metric} = {value:g} regressed "
+                    f"{1.0 - value / res['value']:.1%} vs last good "
+                    f"{res['value']:g} ({mode}, "
+                    f"{entry.get('measured_utc', '?')})")
+            break
+    if not msgs:
+        msgs.append(f"{name}: fresh ({metric or 'no metric'}, "
+                    f"value {rec.get('value')})")
+        return 0, msgs
+    return 1, msgs

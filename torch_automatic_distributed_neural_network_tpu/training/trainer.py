@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import os
 import sys
 import time
 from typing import Any, Callable, Iterable
@@ -88,6 +89,21 @@ class TrainerConfig:
     # rule codes to suppress (analysis.filter_ignored) — the
     # plan/graph/mem/dtype analog of '# tadnn: lint-ok(CODE)'
     preflight_ignore: "tuple[str, ...]" = ()
+    # profile every Nth steady-state step with obs/trace (0 = off).  The
+    # traced step is fenced under a jax.profiler capture, so its wall
+    # time lands in the 'trace' goodput bucket, never 'step'.  Defaults
+    # from TADNN_TRACE_EVERY_N so `tadnn trace <script.py>` can
+    # instrument an unmodified training script.
+    trace_every_n: int = dataclasses.field(
+        default_factory=lambda: _env_int("TADNN_TRACE_EVERY_N"))
+    trace_dir: str = ""  # profiler logdir ("" = a fresh temp dir per trace)
+
+
+def _env_int(name: str) -> int:
+    try:
+        return int(os.environ.get(name, "0") or 0)
+    except ValueError:
+        return 0
 
 
 def _is_step_indexed(data: Any) -> bool:
@@ -317,15 +333,24 @@ class Trainer:
             pending_metrics = None
             i = start
             while i < cfg.steps:
+                # traced steps skip i == start: the first dispatch is
+                # compile-dominated and would profile XLA, not the step
+                traced = bool(cfg.trace_every_n and i != start
+                              and (i - start) % cfg.trace_every_n == 0)
                 t0 = time.perf_counter()
                 n_before = self.ad.n_compiles + self.ad.recompile_count
-                state, step_metrics = self.ad.step(state, batch)
+                if traced:
+                    state, step_metrics = self._traced_step(state, batch, i)
+                else:
+                    state, step_metrics = self.ad.step(state, batch)
                 dur = time.perf_counter() - t0
                 # a dispatch that tripped a (re)trace blocked on XLA, so
-                # its wall time is compile, not useful step time
+                # its wall time is compile, not useful step time; a
+                # traced step is fenced+profiled, so overhead, not goodput
                 tripped = (self.ad.n_compiles + self.ad.recompile_count
                            > n_before)
-                meter.add("compile" if tripped else "step", dur)
+                meter.add("compile" if tripped
+                          else ("trace" if traced else "step"), dur)
                 last_done = i + 1
                 if guard is not None:
                     rolled = self._maybe_rollback(guard, state, step_metrics,
@@ -476,6 +501,37 @@ class Trainer:
                 recompiles=self.ad.recompile_count,
             )
         return state
+
+    def _traced_step(self, state, batch, i: int):
+        """One profiler-instrumented step (cfg.trace_every_n): capture a
+        device timeline around it and journal the ``trace.step``
+        attribution record.  A profiler failure falls back to the plain
+        step — tracing must never take down training."""
+        from ..obs import trace as obs_trace
+
+        captured = {}
+
+        def step_fn(s, b):
+            out = self.ad.step(s, b)
+            captured["out"] = out
+            return out
+
+        try:
+            state, _ = obs_trace.trace_steps(
+                step_fn, state, batch, steps=1, first_step=i,
+                flops_per_step=(self.metrics.flops_per_step
+                                if self.metrics else None),
+                logdir=self.cfg.trace_dir or None,
+            )
+            return state, captured["out"][1]
+        except Exception as e:  # noqa: BLE001 — any capture failure
+            obs_journal.event("trace.error", step=i,
+                              error=f"{type(e).__name__}: {e}")
+            if "out" in captured:
+                # the step itself ran; only the capture/attribution died.
+                # Reuse its result — rerunning would touch donated buffers.
+                return captured["out"]
+            return self.ad.step(state, batch)
 
     def _ckpt_config(self) -> dict | None:
         """run_config to store with a checkpoint; carries the anomaly

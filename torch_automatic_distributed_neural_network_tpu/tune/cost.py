@@ -100,6 +100,7 @@ def score(
     flops_total: float | None = None,
     safety: float | None = None,
     act_profile: dict | None = None,
+    measured_overlap: float | None = None,
 ) -> CostEstimate:
     """Roofline step-time estimate for one candidate.
 
@@ -107,6 +108,13 @@ def score(
     measured one (``utils.profiling.compiled_cost``) when the caller
     has compiled the real step; ``act_profile`` swaps the activation
     heuristic for the liveness profile (``space.candidate_memory``).
+
+    ``measured_overlap`` corrects the model's worst-case comm term with
+    a measured exposed-collective fraction from a trace
+    (``obs.trace.exposed_fraction``): the model charges every wire byte
+    as serial time, but XLA hides part of it behind compute, so a
+    traced run can feed back "only 30% was exposed" and the comm term
+    shrinks to match.  Clamped to [0, 1]; None keeps the worst case.
     """
     chip = topo.chip
     degrees = cand.full_degrees()
@@ -175,6 +183,10 @@ def score(
     budget = hbm_budget(topo) if safety is None else int(
         safety * chip.hbm_bytes)
     fits = mem["total_bytes"] <= budget
+    if measured_overlap is not None:
+        # latency (per-hop setup) cannot be hidden; only the wire time
+        # scales with how much of the collective was actually exposed
+        comm_s *= min(1.0, max(0.0, measured_overlap))
     step = max(compute_s, hbm_s) + comm_s + latency_s
     return CostEstimate(
         candidate=cand,
@@ -191,8 +203,21 @@ def score(
             "remat": remat,
             "flops_per_device": flops / topo.num_devices,
             "flops_source": "measured" if flops_total else "analytic_6PN",
+            **({"measured_overlap": round(
+                min(1.0, max(0.0, measured_overlap)), 4)}
+               if measured_overlap is not None else {}),
         },
     )
+
+
+def overlap_from_trace(trace_steps: Sequence[dict]) -> float | None:
+    """Measured exposed-collective fraction over ``trace.step`` records
+    (journal dicts or ``obs.trace.attribute`` output) — the value to
+    feed back as ``score(measured_overlap=...)``.  None when the trace
+    saw no collectives."""
+    from ..obs.trace import exposed_fraction
+
+    return exposed_fraction(trace_steps)
 
 
 def rank(
