@@ -95,6 +95,88 @@ def test_probe_backend_short_circuits_on_cpu(monkeypatch):
     assert __graft_entry__._probe_backend(timeout_s=1) is None
 
 
+def test_dryrun_backend_unreachable_degrades_to_smoke(monkeypatch, capsys):
+    """Satellite r05 fix: an unreachable backend must not go rc-124 dark.
+
+    The parent probes out-of-process; on failure it (1) emits an explicit
+    ``status=backend_unreachable`` JSON record (the bench never-replay
+    contract applied to the multichip trajectory) and (2) re-execs the
+    CPU sim with the SMOKE subset so the run fits the remaining budget.
+    """
+    import json
+
+    calls = []
+    monkeypatch.delenv(__graft_entry__._CHILD_FLAG, raising=False)
+    # conftest forces count=8; ask for 4 so the parent branch runs
+    monkeypatch.setattr(
+        __graft_entry__, "_probe_backend",
+        lambda timeout_s=120: "backend init hung > 120s (simulated)",
+    )
+    monkeypatch.setattr(
+        __graft_entry__, "_reexec_cpu_sim",
+        lambda n, smoke=False: calls.append((n, smoke)),
+    )
+    __graft_entry__.dryrun_multichip(4)
+    assert calls == [(4, True)]
+    recs = [json.loads(ln) for ln in capsys.readouterr().out.splitlines()
+            if ln.startswith("{")]
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["status"] == "backend_unreachable"
+    assert rec["fallback"] == "cpu_sim_smoke"
+    assert rec["n_devices"] == 4
+    assert "error" in rec and rec["configs"]
+
+
+def test_dryrun_healthy_backend_keeps_full_matrix(monkeypatch):
+    calls = []
+    monkeypatch.delenv(__graft_entry__._CHILD_FLAG, raising=False)
+    monkeypatch.setattr(__graft_entry__, "_probe_backend",
+                        lambda timeout_s=120: None)
+    monkeypatch.setattr(
+        __graft_entry__, "_reexec_cpu_sim",
+        lambda n, smoke=False: calls.append((n, smoke)),
+    )
+    __graft_entry__.dryrun_multichip(4)
+    assert calls == [(4, False)]
+
+
+def test_dryrun_budget_exhausted_emits_record_and_exits_clean(
+        monkeypatch, capsys):
+    """When the child's wall-clock budget runs out mid-matrix it must say
+    so explicitly (completed/skipped split) and return rc 0 — a partial
+    pass on record beats a full pass killed dark at rc 124."""
+    import json
+
+    monkeypatch.setenv(__graft_entry__._CHILD_FLAG, "1")
+    monkeypatch.setenv(__graft_entry__._BUDGET_ENV, "1e-9")
+    monkeypatch.setattr(__graft_entry__, "_run_config",
+                        lambda *a, **k: None)
+    __graft_entry__.dryrun_multichip(8)
+    recs = [json.loads(ln) for ln in capsys.readouterr().out.splitlines()
+            if ln.startswith("{")]
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["status"] == "budget_exhausted"
+    assert rec["completed"] == ["tp_fsdp"]  # first config always runs
+    assert rec["skipped"]  # the rest are named, not silently dropped
+    assert set(rec) >= {"budget_s", "elapsed_s"}
+
+
+def test_dryrun_smoke_flag_filters_to_smoke_subset(monkeypatch, capsys):
+    monkeypatch.setenv(__graft_entry__._CHILD_FLAG, "1")
+    monkeypatch.setenv(__graft_entry__._SMOKE_FLAG, "1")
+    ran = []
+    monkeypatch.setattr(
+        __graft_entry__, "_run_config",
+        lambda label, *a, **k: ran.append(label),
+    )
+    __graft_entry__.dryrun_multichip(8)
+    out = capsys.readouterr().out
+    assert ran == list(__graft_entry__._SMOKE_CONFIGS)
+    assert f"ALL {len(ran)}/{len(ran)} configs ok" in out
+
+
 @pytest.mark.slow
 def test_dryrun_multichip_end_to_end_with_poisoned_parent(tmp_path):
     """Full dryrun(2) through the re-exec machinery, with a tripwire.

@@ -1,0 +1,412 @@
+"""ZeRO-1 cross-replica weight-update sharding (arxiv 2004.13336).
+
+Covers the whole zero1 slice: the planner's opt_spec_tree, the RS+AG
+collective-traffic profile that replaces the dp grad all-reduce, GL002
+cleanliness, the mem_lint moment-shard accounting, tuner enumeration /
+memory-tight ranking / cache-key separation, the choose_strategy
+single-device degenerate, and dp-vs-dp+zero1 numeric parity on the
+8-device CPU sim from conftest.py.
+"""
+
+import json
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import torch_automatic_distributed_neural_network_tpu as tad
+from torch_automatic_distributed_neural_network_tpu import (
+    analysis,
+    planner,
+    topology,
+    tune,
+)
+from torch_automatic_distributed_neural_network_tpu.analysis import mem_lint
+from torch_automatic_distributed_neural_network_tpu.models import MLP
+from torch_automatic_distributed_neural_network_tpu.obs import (
+    journal as obs_journal,
+)
+from torch_automatic_distributed_neural_network_tpu.training import (
+    softmax_xent_loss,
+)
+
+
+class Shape:
+    def __init__(self, *shape, dtype=jnp.float32):
+        self.shape = shape
+        self.dtype = dtype
+
+
+def divisible_params(d=64, ff=256):
+    """Every dim divisible by 8 — zero1 shards every leaf."""
+    return {
+        "up": {"kernel": Shape(d, ff), "bias": Shape(ff)},
+        "down": {"kernel": Shape(ff, d), "bias": Shape(d)},
+    }
+
+
+def codes(findings):
+    return [f.code for f in findings]
+
+
+def toy_batch(seed=0, batch=16, dim=8, classes=10):
+    rng = np.random.RandomState(seed)
+    return {
+        "x": jnp.asarray(rng.randn(batch, dim), jnp.float32),
+        "label": jnp.asarray(rng.randint(0, classes, size=(batch,))),
+    }
+
+
+def _mlp_ad(optimizer=None, *, zero1=True, strategy="dp", features=(64, 32)):
+    return tad.AutoDistribute(
+        MLP(features=features),
+        optimizer=optimizer or optax.adam(1e-2),
+        loss_fn=softmax_xent_loss,
+        strategy=strategy,
+        zero1=zero1,
+    )
+
+
+# ---------------------------------------------------------------------------
+# planner: zero1_spec_tree + make_plan wiring
+# ---------------------------------------------------------------------------
+
+
+class TestZero1SpecTree:
+    def test_largest_divisible_dim_shards_over_data(self):
+        params = {"w": Shape(16, 64), "b": Shape(32)}
+        specs = {"w": P(), "b": P()}
+        out = planner.zero1_spec_tree(params, {"data": 8}, specs)
+        assert out["w"] == P(None, "data")  # 64 > 16: second dim wins
+        assert out["b"] == P("data")
+
+    def test_indivisible_and_scalar_leaves_keep_param_spec(self):
+        params = {"odd": Shape(3, 5), "s": Shape()}
+        specs = {"odd": P(), "s": P()}
+        out = planner.zero1_spec_tree(params, {"data": 8}, specs)
+        assert out["odd"] == P() and out["s"] == P()
+
+    def test_respects_existing_param_sharding(self):
+        # a tp-sharded kernel: 'data' must land on a dim tensor doesn't own
+        params = {"w": Shape(64, 64)}
+        specs = {"w": P(None, "tensor")}
+        out = planner.zero1_spec_tree(
+            params, {"data": 4, "tensor": 2}, specs)
+        assert out["w"] == P("data", "tensor")
+
+    def test_noop_without_data_axis(self):
+        params = {"w": Shape(16, 64)}
+        specs = {"w": P("fsdp", None)}
+        assert planner.zero1_spec_tree(
+            params, {"fsdp": 8}, specs) is specs
+
+    def test_leaf_count_mismatch_raises(self):
+        with pytest.raises(ValueError, match="does not match"):
+            planner.zero1_spec_tree(
+                {"a": Shape(8), "b": Shape(8)}, {"data": 8}, {"a": P()})
+
+
+class TestMakePlanZero1:
+    def test_dp_plan_gains_distinct_opt_spec_tree(self, devices8):
+        plan = planner.make_plan(divisible_params(), strategy="dp",
+                                 zero1=True)
+        assert plan.zero1
+        assert plan.opt_spec_tree is not None
+        opt = jax.tree.leaves(plan.opt_spec_tree,
+                              is_leaf=lambda x: isinstance(x, P))
+        par = jax.tree.leaves(plan.param_specs,
+                              is_leaf=lambda x: isinstance(x, P))
+        assert opt != par  # params untouched, moments sharded
+        assert all(s == P() for s in par)
+        assert all("data" in planner.spec_axes(s) for s in opt)
+        assert "+zero1" in plan.describe()
+
+    def test_default_is_off(self, devices8):
+        plan = planner.make_plan(divisible_params(), strategy="dp")
+        assert not plan.zero1 and plan.opt_spec_tree is None
+        assert "+zero1" not in plan.describe()
+
+    def test_downgrades_cleanly_without_data_axis(self, devices8):
+        plan = planner.make_plan(divisible_params(), strategy="fsdp",
+                                 zero1=True)
+        assert not plan.zero1 and plan.opt_spec_tree is None
+
+
+def test_choose_strategy_single_device_is_identity_dp():
+    """Satellite fix: n==1 must short-circuit to dp[data1], never fall
+    through to the fsdp catch-all (a {'fsdp': 1} mesh is a dead axis
+    that trips PL004 downstream)."""
+    topo = topology.Topology(num_devices=1, num_hosts=1,
+                             platform="cpu", device_kind="cpu")
+    big = {"big": {"kernel": Shape(32768, 32768)}}  # would want fsdp
+    assert planner.choose_strategy(big, topo) == ("dp", {"data": 1})
+
+
+# ---------------------------------------------------------------------------
+# collective-traffic profile: RS+AG replaces the dp all-reduce
+# ---------------------------------------------------------------------------
+
+
+class TestZero1CollectiveBytes:
+    def test_rs_ag_replace_dp_allreduce(self, devices8):
+        params = divisible_params()
+        plan = planner.make_plan(params, strategy="dp", zero1=True)
+        est = planner.expected_collective_bytes(plan, params)
+        per = est["per_device"]
+        pbytes = sum(math.prod(s.shape) * 4
+                     for s in jax.tree.leaves(params))
+        rs, ag = (per["zero1_grad_reduce_scatter"],
+                  per["zero1_param_allgather"])
+        # every leaf is divisible: the whole grad payload moves as RS+AG
+        assert rs["payload_bytes"] == pbytes
+        assert ag["payload_bytes"] == pbytes
+        assert rs["wire_bytes"] == int(7 / 8 * pbytes)
+        assert ag["wire_bytes"] == int(7 / 8 * pbytes)
+        # ...and the 2(n-1)/n all-reduce is GONE, not double-charged
+        assert per["grad_allreduce"]["wire_bytes"] == 0
+        # same total wire as dp's single all-reduce: zero1 trades no
+        # bandwidth, only memory (the paper's headline property)
+        dp_plan = planner.make_plan(params, strategy="dp")
+        dp = planner.expected_collective_bytes(dp_plan, params)
+        assert (rs["wire_bytes"] + ag["wire_bytes"]
+                == dp["per_device"]["grad_allreduce"]["wire_bytes"])
+
+    def test_non_zero1_plan_has_no_zero1_categories(self, devices8):
+        plan = planner.make_plan(divisible_params(), strategy="dp")
+        per = planner.expected_collective_bytes(
+            plan, divisible_params())["per_device"]
+        assert "zero1_grad_reduce_scatter" not in per
+        assert "zero1_param_allgather" not in per
+
+    def test_indivisible_leaf_keeps_residual_allreduce(self, devices8):
+        params = {**divisible_params(), "odd": {"w": Shape(3, 5)}}
+        plan = planner.make_plan(params, strategy="dp", zero1=True)
+        per = planner.expected_collective_bytes(plan, params)["per_device"]
+        # the (3,5) leaf can't shard on data=8: its grad still rides a
+        # plain all-reduce (2(n-1)/n of its 60-byte payload)
+        assert per["grad_allreduce"]["payload_bytes"] == 15 * 4
+        assert per["grad_allreduce"]["wire_bytes"] == int(
+            2 * 7 / 8 * 15 * 4)
+
+    def test_param_allgather_does_not_scale_with_grad_accum(self, devices8):
+        params = divisible_params()
+        plan = planner.make_plan(params, strategy="dp", zero1=True)
+        one = planner.expected_collective_bytes(
+            plan, params, grad_accum=1)["per_device"]
+        four = planner.expected_collective_bytes(
+            plan, params, grad_accum=4)["per_device"]
+        # grads reduce-scatter once per accumulation slice...
+        assert (four["zero1_grad_reduce_scatter"]["wire_bytes"]
+                == 4 * one["zero1_grad_reduce_scatter"]["wire_bytes"])
+        # ...but the fresh params gather once per optimizer step
+        assert (four["zero1_param_allgather"]["wire_bytes"]
+                == one["zero1_param_allgather"]["wire_bytes"])
+
+
+# ---------------------------------------------------------------------------
+# graph lint: the zero1 RS/AG over 'data' must be GL002-clean
+# ---------------------------------------------------------------------------
+
+
+def test_preflight_is_gl002_clean_on_zero1_plan(devices8):
+    ad = _mlp_ad()
+    batch = toy_batch()
+    ad.init(jax.random.key(0), batch)
+    assert ad.plan.zero1
+    findings = analysis.preflight(ad, batch, rng=jax.random.key(1),
+                                  budget="16GiB")
+    assert "GL002" not in codes(findings), [
+        (f.code, f.msg) for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# mem_lint: moments charged by the zero1 shard fraction
+# ---------------------------------------------------------------------------
+
+
+def _opt_bytes(ad):
+    batch = toy_batch()
+    ad.build_plan(jax.random.key(0), batch)
+    state_abs = jax.eval_shape(ad._make_state_fn(batch),
+                               jax.random.key(0))
+    est = mem_lint.estimate_step_memory(
+        None, ad.plan, state_abs.params, opt_state=state_abs.opt_state)
+    return est
+
+
+class TestMemLintZero1:
+    def test_adam_two_moments_shard_dp_fold(self, devices8):
+        repl = _opt_bytes(_mlp_ad(optax.adam(1e-2), zero1=False))
+        z1 = _opt_bytes(_mlp_ad(optax.adam(1e-2), zero1=True))
+        assert repl.params_bytes == z1.params_bytes  # params untouched
+        # MLP(64,32) on d=8 input: every dim divides 8 -> both adam
+        # moments shard exactly 8-fold (plus adam's scalar count)
+        assert z1.optimizer_bytes <= 1.15 * repl.optimizer_bytes / 8
+        # and the moments really are 2x param bytes when replicated
+        assert repl.optimizer_bytes == pytest.approx(
+            2 * repl.params_bytes, rel=0.01)
+
+    def test_sgd_momentum_single_moment_shards(self, devices8):
+        opt = optax.sgd(0.1, momentum=0.9)
+        repl = _opt_bytes(_mlp_ad(opt, zero1=False))
+        z1 = _opt_bytes(_mlp_ad(opt, zero1=True))
+        assert repl.optimizer_bytes == pytest.approx(
+            repl.params_bytes, rel=0.01)  # one momentum tree
+        assert z1.optimizer_bytes <= 1.15 * repl.optimizer_bytes / 8
+
+    def test_ml001_flips_clean_on_config_that_only_fits_with_zero1(
+            self, devices8):
+        repl = _opt_bytes(_mlp_ad(optax.adam(1e-2), zero1=False))
+        z1 = _opt_bytes(_mlp_ad(optax.adam(1e-2), zero1=True))
+        budget = (repl.peak_bytes + z1.peak_bytes) // 2
+        over = mem_lint.lint_memory(repl, budget_bytes=budget)
+        fits = mem_lint.lint_memory(z1, budget_bytes=budget, headroom=0.05)
+        assert "ML001" in codes(over)  # replicated state predicts OOM
+        assert "ML001" not in codes(fits)  # same model+budget, zero1 fits
+
+
+# ---------------------------------------------------------------------------
+# tune: enumeration, memory-tight ranking, cache-key separation
+# ---------------------------------------------------------------------------
+
+
+def transformer_like_params(d=256, ff=1024, vocab=1024):
+    return {
+        "embed": {"embedding": Shape(vocab, d)},
+        "layers_0": {
+            "mlp": {
+                "up_proj": {"kernel": Shape(d, ff)},
+                "down_proj": {"kernel": Shape(ff, d)},
+            },
+        },
+        "lm_head": {"kernel": Shape(d, vocab)},
+    }
+
+
+def topo8(device_kind="v5p"):
+    return topology.Topology(num_devices=8, num_hosts=1,
+                             platform="tpu", device_kind=device_kind)
+
+
+class TestTuneZero1:
+    def test_space_enumerates_zero1_twins_of_data_meshes(self):
+        kept, _ = tune.enumerate_candidates(
+            transformer_like_params(), topo8("v5p"))
+        by_label = {c.label(): c for c in kept}
+        assert "dp[data8]" in by_label and "dp[data8]+z1" in by_label
+        assert by_label["dp[data8]+z1"].zero1
+        # fsdp has no data axis -> no twin to enumerate
+        assert not any(c.zero1 for c in kept if c.strategy == "fsdp")
+
+    def test_space_zero1_off_suppresses_twins(self):
+        kept, _ = tune.enumerate_candidates(
+            transformer_like_params(), topo8("v5p"), zero1=False)
+        assert not any(c.zero1 for c in kept)
+
+    def test_memory_tight_budget_ranks_zero1_above_plain_dp(self):
+        """The acceptance scenario: fp32 adam state of a ~4 GiB kernel
+        is ~17 GiB replicated — over v5e's 16 GiB — while the zero1
+        variant's moments/8 fit.  Fits-first ordering must put dp+z1
+        strictly above plain dp."""
+        big = {"big": {"kernel": Shape(32768, 32768)}}
+        cands = [tune.Candidate("dp", (("data", 8),)),
+                 tune.Candidate("dp", (("data", 8),), zero1=True)]
+        ranked = tune.rank(big, topo8("v5e"), cands)
+        assert [e.candidate.zero1 for e in ranked] == [True, False]
+        assert ranked[0].fits and not ranked[1].fits
+        assert ranked[0].to_json()["zero1"] is True
+
+    def test_zero1_state_bytes_are_moments_over_dp(self):
+        cand = tune.Candidate("dp", (("data", 8),), zero1=True)
+        mem = tune.space.candidate_memory(divisible_params(), cand)
+        pb = sum(math.prod(s.shape) * 4
+                 for s in jax.tree.leaves(divisible_params()))
+        # params+grads replicated (2P) + 2 adam moments sharded (2P/8)
+        assert mem["state_bytes"] == int(2 * pb + 2 * pb / 8)
+
+    def test_policy_zero1_changes_cache_key(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TADNN_TUNE_CACHE", str(tmp_path / "c.jsonl"))
+        on = tune.tune(transformer_like_params(), topo8("v5p"),
+                       policy=tune.TunePolicy(zero1=True))
+        off = tune.tune(transformer_like_params(), topo8("v5p"),
+                        policy=tune.TunePolicy(zero1=False))
+        assert on.key != off.key  # a cached plain-dp decision can never
+        assert not off.zero1      # shadow a dp+zero1 search
+
+    def test_zero1_winner_round_trips_through_cache(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TADNN_TUNE_CACHE", str(tmp_path / "c.jsonl"))
+        big = {"big": {"kernel": Shape(32768, 32768)}}
+        first = tune.tune(big, topo8("v5e"))
+        assert first.source == "cost_model"
+        assert first.strategy == "dp" and first.zero1  # beats fsdp on comm
+        again = tune.tune(big, topo8("v5e"))
+        assert again.source == "cache"
+        assert (again.strategy, again.zero1) == ("dp", True)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end on the 8-device sim: parity, sharding, journal
+# ---------------------------------------------------------------------------
+
+
+def _run(ad, steps=6):
+    state = ad.init(jax.random.key(0), toy_batch())
+    losses = []
+    for i in range(steps):
+        state, metrics = ad.step(state, toy_batch(seed=i))
+        losses.append(float(metrics["loss"]))
+    return state, losses
+
+
+class TestZero1Parity:
+    def test_dp_vs_dp_zero1_numeric_parity(self, devices8):
+        """Satellite acceptance: same model/data/seeds under dp and
+        dp+zero1 — allclose loss trajectory, allclose params, and the
+        zero1 run's gathered params bitwise identical on every replica
+        (the all-gather at update time leaves no per-replica drift)."""
+        s_dp, l_dp = _run(_mlp_ad(zero1=False))
+        s_z1, l_z1 = _run(_mlp_ad(zero1=True))
+        np.testing.assert_allclose(l_dp, l_z1, rtol=1e-4)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6),
+            s_dp.params, s_z1.params)
+        for leaf in jax.tree.leaves(s_z1.params):
+            shards = leaf.addressable_shards
+            assert all(s.data.shape == leaf.shape for s in shards)
+            ref = np.asarray(shards[0].data)
+            for s in shards[1:]:
+                np.testing.assert_array_equal(ref, np.asarray(s.data))
+
+    def test_opt_state_is_actually_sharded(self, devices8):
+        ad = _mlp_ad(zero1=True)
+        state = ad.init(jax.random.key(0), toy_batch())
+        mu = state.opt_state[0].mu
+        sharded = [leaf for leaf in jax.tree.leaves(mu)
+                   if leaf.addressable_shards[0].data.shape != leaf.shape]
+        assert sharded, "no adam moment leaf is sharded under zero1"
+        for leaf in sharded:
+            shard = leaf.addressable_shards[0].data
+            assert math.prod(shard.shape) * 8 == math.prod(leaf.shape)
+
+
+def test_plan_zero1_journal_event(devices8):
+    j = obs_journal.set_default(obs_journal.Journal())
+    try:
+        ad = _mlp_ad(zero1=True)
+        ad.build_plan(jax.random.key(0), toy_batch())
+        recs = {r["name"]: r for r in j.records}
+        assert recs["plan"]["zero1"] is True
+        z1 = recs["plan.zero1"]
+        assert z1["data_degree"] == 8
+        assert z1["predicted_reduce_scatter_bytes"] > 0
+        assert z1["predicted_allgather_bytes"] > 0
+        assert z1["compiled_bytes"] is None  # filled by the crosscheck
+        json.dumps(z1)  # journal rows must stay JSON-serializable
+    finally:
+        obs_journal.set_default(None)

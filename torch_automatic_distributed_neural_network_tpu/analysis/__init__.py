@@ -423,7 +423,8 @@ def memory_check(ad: Any, sample_batch: Any, *, rng: Any = None,
     findings += mem_lint.lint_memory(est, budget_bytes=budget_b,
                                      headroom=hr)
     report = {**est.to_json(), "budget_bytes": int(budget_b),
-              "headroom": hr}
+              "headroom": hr,
+              "zero1": bool(getattr(ad.plan, "zero1", False))}
     if compiled:
         comp = ad.compile_report(rng, sample_batch) or {}
         peak_c = comp.get("per_device_peak_bytes")

@@ -157,14 +157,24 @@ def _allowed_axes(plan: planner_mod.ShardPlan,
     # ZeRO-3 axes: batch-carrying axes that also shard params — the ones
     # the model predicts param all-gather / grad reduce-scatter over.
     zero3 = {a for a in batch_axes & param_axes if a != "expert"}
+    # ZeRO-1 axes: axes the opt_spec_tree shards beyond the param specs —
+    # the plan's zero1 RS (grads onto the opt shard) and AG (fresh
+    # params) ride these, so they're accounted traffic, not reshards.
+    zero1: set[str] = set()
+    if getattr(plan, "zero1", False) and getattr(
+            plan, "opt_spec_tree", None) is not None:
+        for spec in jax.tree.leaves(plan.opt_spec_tree,
+                                    is_leaf=lambda x: isinstance(x, P)):
+            zero1 |= planner_mod.spec_axes(spec)
+        zero1 = {a for a in zero1 - param_axes if degrees.get(a, 1) > 1}
     tensor = live("tensor")
     seq = live("seq")
     pipe = live("pipe")
     expert = live("expert")
     return {
-        "gather": zero3 | tensor | seq | pipe,
+        "gather": zero3 | zero1 | tensor | seq | pipe,
         "reduce": batch_axes | zero3 | tensor | seq | pipe,
-        "scatter": zero3 | tensor | seq,
+        "scatter": zero3 | zero1 | tensor | seq,
         "a2a": expert | seq,
         "permute": seq | pipe,
     }

@@ -315,7 +315,14 @@ def estimate_step_memory(
     param_mult = params_pd / max(1, params_total)
     opt_pd = 0
     if opt_state is not None:
-        fracs = _shape_fracs(abstract_params, plan.param_specs, deg)
+        # under zero1 the optimizer state follows the plan's dedicated
+        # opt_spec_tree (moments sharded over 'data'), not the param
+        # specs — this is what makes ML001/ML002 predict the ~DP-fold
+        # optimizer-HBM cut device-free
+        opt_specs = getattr(plan, "opt_spec_tree", None)
+        if opt_specs is None:
+            opt_specs = plan.param_specs
+        fracs = _shape_fracs(abstract_params, opt_specs, deg)
         opt_pd = _matched_tree_bytes(opt_state, fracs)
     ms_pd = sum(
         _leaf_bytes(leaf)

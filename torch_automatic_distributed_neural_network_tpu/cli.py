@@ -250,6 +250,7 @@ def cmd_tune(args: argparse.Namespace) -> int:
         batch_items=tune.estimate_batch_items(sample),
         use_cache=not args.no_cache,
         act_profile=act_profile,
+        zero1=not args.no_zero1,
     )
     result = tune.tune(abstract, topo, policy=policy)
     ranked = result.ranked
@@ -258,7 +259,7 @@ def cmd_tune(args: argparse.Namespace) -> int:
             abstract, topo, grad_accums=policy.grad_accums,
             max_tensor=policy.max_tensor, state_factor=policy.state_factor,
             batch_items=policy.batch_items, safety=policy.safety,
-            act_profile=policy.act_profile,
+            act_profile=policy.act_profile, zero1=policy.zero1,
         )
         ranked = tune.rank(abstract, topo, kept,
                            state_factor=policy.state_factor,
@@ -290,7 +291,8 @@ def cmd_tune(args: argparse.Namespace) -> int:
             print(json.dumps(row))
         print(json.dumps({
             "chosen_strategy": result.strategy, "mesh": result.degrees,
-            "grad_accum": result.grad_accum, "source": result.source,
+            "grad_accum": result.grad_accum, "zero1": result.zero1,
+            "source": result.source,
             "cache_key": result.key,
         }))
         return 0
@@ -306,7 +308,9 @@ def cmd_tune(args: argparse.Namespace) -> int:
     for i, est in enumerate(ranked):
         b = est.breakdown
         mesh = "x".join(f"{a}{n}" for a, n in est.candidate.degrees if n > 1)
-        line = (f"{i:>4} {est.candidate.strategy:<9} {mesh or '1':<24} "
+        strat = est.candidate.strategy + (
+            "+z1" if est.candidate.zero1 else "")
+        line = (f"{i:>4} {strat:<9} {mesh or '1':<24} "
                 f"{est.candidate.grad_accum:>2} "
                 f"{est.step_time_s * 1e3:>9.3f} {b['compute_ms']:>8.3f} "
                 f"{b['comm_ms']:>8.3f} {b['hbm_ms']:>8.3f} "
@@ -316,7 +320,8 @@ def cmd_tune(args: argparse.Namespace) -> int:
         if measured:
             line += f" {m:>9.3f}" if m is not None else f" {'-':>9}"
         print(line)
-    print(f"chosen: {result.strategy} {result.degrees} "
+    print(f"chosen: {result.strategy}{'+z1' if result.zero1 else ''} "
+          f"{result.degrees} "
           f"grad_accum={result.grad_accum} ({result.source}; "
           f"cache {tune.cache.cache_path()})")
     return 0
@@ -491,8 +496,11 @@ def _print_memory_report(report: dict) -> None:
     ]
     mesh = "x".join(f"{a}{n}" for a, n in
                     sorted((report.get("degrees") or {}).items()))
+    strat = str(report.get("strategy"))
+    if report.get("zero1"):
+        strat += "+zero1"
     print(f"memory estimate (static, per device; strategy "
-          f"{report.get('strategy')}, mesh {mesh or '1'}, "
+          f"{strat}, mesh {mesh or '1'}, "
           f"grad_accum {report.get('grad_accum')}, "
           f"remat {'on' if report.get('remat') else 'off'}):")
     for name, val in rows:
@@ -553,7 +561,7 @@ def cmd_check(args: argparse.Namespace) -> int:
         ad = AutoDistribute(
             model, optimizer=optax.adamw(1e-4), loss_fn=loss,
             strategy=args.strategy, precision=args.precision,
-            grad_accum=args.grad_accum,
+            grad_accum=args.grad_accum, zero1=args.zero1,
         )
         mem_findings, mem_report = analysis.memory_check(
             ad, sample, rng=jax.random.key(0), budget=args.budget,
@@ -678,6 +686,10 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--no-cache", action="store_true",
                    help="skip the persistent tuning cache "
                         "(~/.cache/tadnn/, TADNN_TUNE_CACHE)")
+    p.add_argument("--no-zero1", action="store_true",
+                   help="drop the ZeRO-1 optimizer-state-sharding "
+                        "variants from the search space (changes the "
+                        "cache key)")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_tune)
 
@@ -822,6 +834,10 @@ def main(argv: list[str] | None = None) -> int:
                    help="sharding strategy for --memory (default fsdp)")
     p.add_argument("--precision", default="fp32")
     p.add_argument("--grad-accum", type=int, default=1)
+    p.add_argument("--zero1", action="store_true",
+                   help="ZeRO-1 for --memory: shard optimizer moments "
+                        "over the data axis (the per-chip optimizer row "
+                        "drops ~DP-fold)")
     p.set_defaults(fn=cmd_check)
 
     p = sub.add_parser(

@@ -93,7 +93,8 @@ def generate(target: str, metrics_path: str | None = None) -> dict:
     plan = last("plan")
     if plan:
         report["plan"] = {k: plan.get(k)
-                          for k in ("strategy", "mesh", "remat", "precision")
+                          for k in ("strategy", "mesh", "remat", "precision",
+                                    "zero1")
                           if plan.get(k) is not None}
     decision = last("tune.decision")
     hit = last("tune.cache_hit")
@@ -316,7 +317,10 @@ def format_report(report: dict) -> str:
              f"{report.get('journal_wall_s', 0.0):.1f}s span)"]
     plan = report.get("plan")
     if plan:
-        lines.append(f"plan: strategy={plan.get('strategy')} "
+        strat = str(plan.get("strategy"))
+        if plan.get("zero1"):
+            strat += "+zero1"
+        lines.append(f"plan: strategy={strat} "
                      f"mesh={plan.get('mesh')}")
     tun = report.get("tuning")
     if tun:

@@ -125,13 +125,22 @@ MOE_TP_RULES: tuple[Rule, ...] = (
 
 @dataclasses.dataclass
 class ShardPlan:
-    """The planner's output: everything needed to jit a sharded step."""
+    """The planner's output: everything needed to jit a sharded step.
+
+    ``opt_spec_tree`` (set when ``zero1=True``) is a params-structured
+    PartitionSpec tree for the OPTIMIZER state only: each param's
+    largest still-unsharded divisible dim additionally shards over the
+    ``data`` axis (ZeRO-1 cross-replica weight-update sharding, arxiv
+    2004.13336) while the params themselves keep ``param_specs``.
+    """
 
     mesh: Mesh
     strategy: str
     param_specs: Any  # pytree of PartitionSpec, same structure as params
     batch_spec: P  # spec for the leading (batch) dim of inputs
     remat: bool = False
+    zero1: bool = False
+    opt_spec_tree: Any = None  # params-structured specs for opt state
 
     def param_shardings(self) -> Any:
         return jax.tree.map(
@@ -140,14 +149,31 @@ class ShardPlan:
             is_leaf=lambda x: isinstance(x, P),
         )
 
+    def opt_shardings(self) -> Any:
+        """NamedShardings for the optimizer-state specs (param specs
+        when no distinct zero1 tree exists)."""
+        specs = (self.opt_spec_tree if self.opt_spec_tree is not None
+                 else self.param_specs)
+        return jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s),
+            specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
     def batch_sharding(self) -> NamedSharding:
         return NamedSharding(self.mesh, self.batch_spec)
 
     def describe(self) -> str:
-        lines = [f"ShardPlan(strategy={self.strategy}, mesh={dict(zip(self.mesh.axis_names, self.mesh.devices.shape))})"]
+        strat = self.strategy + ("+zero1" if self.zero1 else "")
+        lines = [f"ShardPlan(strategy={strat}, mesh={dict(zip(self.mesh.axis_names, self.mesh.devices.shape))})"]
         flat = _flatten_with_paths(self.param_specs)
-        for path, spec in flat:
-            lines.append(f"  {path}: {spec}")
+        opt_flat = (_flatten_with_paths(self.opt_spec_tree)
+                    if self.opt_spec_tree is not None else None)
+        for i, (path, spec) in enumerate(flat):
+            line = f"  {path}: {spec}"
+            if opt_flat is not None and opt_flat[i][1] != spec:
+                line += f"  [opt: {opt_flat[i][1]}]"
+            lines.append(line)
         return "\n".join(lines)
 
 
@@ -360,6 +386,43 @@ def _spec_uses_axis(spec: P, axis: str) -> bool:
     return False
 
 
+def zero1_spec_tree(
+    abstract_params: Any,
+    mesh: Mesh,
+    param_specs: Any,
+) -> Any:
+    """ZeRO-1 optimizer-state spec tree (arxiv 2004.13336).
+
+    Per param: the largest still-unsharded divisible dim additionally
+    shards over the ``data`` axis, so the optimizer moments (and the
+    weight update itself) live 1/dp-th per replica while the params keep
+    their own specs.  Indivisible leaves keep the param spec — their
+    moments stay replicated and are charged honestly by the memory
+    model.  Pure shape math; ``mesh`` may be a degrees mapping.
+    """
+    degrees = topo_mod.mesh_degrees(mesh)
+    if degrees.get("data", 1) <= 1:
+        return param_specs  # no data replicas — nothing to shard over
+    spec_flat, treedef = jax.tree_util.tree_flatten(
+        param_specs, is_leaf=lambda x: isinstance(x, P)
+    )
+    leaves = jax.tree.leaves(abstract_params)
+    if len(spec_flat) != len(leaves):
+        raise ValueError(
+            f"param_specs ({len(spec_flat)} leaves) does not match "
+            f"abstract_params ({len(leaves)} leaves)"
+        )
+    out = []
+    for spec, leaf in zip(spec_flat, leaves):
+        shape = tuple(getattr(leaf, "shape", ()))
+        if not shape:
+            out.append(spec)
+            continue
+        out.append(_fsdp_spec(shape, degrees, existing=spec,
+                              fsdp_axes=("data",)))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 def batch_partition_spec(mesh: Mesh) -> P:
     """Batch dim sharded over every data-carrying axis present in the mesh.
 
@@ -477,8 +540,14 @@ def choose_strategy(
         return "dp", {"data": n}
     if tp_applicable(abstract_params, rules):
         for t in (8, 4, 2):
-            if n % t == 0 and t <= n:
+            # both axes must stay nontrivial: n == t would leave a dead
+            # degree-1 fsdp axis (spurious PL004 downstream)
+            if n % t == 0 and n // t >= 2:
                 return "tp_fsdp", {"fsdp": n // t, "tensor": t}
+    # defensive: a degenerate topology must never reach the fsdp
+    # catch-all — a {"fsdp": 1} mesh is a dead axis, not a strategy
+    if n == 1:
+        return "dp", {"data": 1}
     return "fsdp", {"fsdp": n}
 
 
@@ -519,9 +588,21 @@ def expected_collective_bytes(
       backward re-gather, the remat-compatible schedule).
     - ``grad_reduce_scatter``: the matching gradient shard reduction.
 
+    With ``plan.zero1`` (cross-replica weight-update sharding, arxiv
+    2004.13336) two more categories appear for the leaves whose
+    ``opt_spec_tree`` spec shards over axes the param spec does not:
+
+    - ``zero1_grad_reduce_scatter``: the grad all-reduce over those
+      axes is REPLACED by a reduce-scatter onto the optimizer shard
+      (wire ``(n-1)/n`` instead of ``2(n-1)/n`` of payload);
+    - ``zero1_param_allgather``: the freshly updated params are
+      all-gathered once per optimizer step (NOT per accumulation
+      slice — the update runs once, after accumulation).
+
     Wire bytes use the ring formulas (allreduce ``2(n-1)/n``, gather/
     scatter ``(n-1)/n`` of payload).  Gradient-path collectives run once
-    per accumulation slice, so everything scales by ``grad_accum``.
+    per accumulation slice, so everything except the zero1 param
+    all-gather scales by ``grad_accum``.
 
     Activation-shaped traffic (tp activation all-reduces, MoE dispatch
     all_to_all, pipeline stage p2p) depends on model internals invisible
@@ -545,16 +626,38 @@ def expected_collective_bytes(
             f"abstract_params ({len(leaves)} leaves)"
         )
 
+    zero1_active = bool(getattr(plan, "zero1", False))
+    opt_specs = None
+    if zero1_active and getattr(plan, "opt_spec_tree", None) is not None:
+        opt_specs = jax.tree.leaves(plan.opt_spec_tree,
+                                    is_leaf=lambda x: isinstance(x, P))
+        if len(opt_specs) != len(specs):
+            raise ValueError(
+                f"opt_spec_tree ({len(opt_specs)} leaves) does not match "
+                f"param_specs ({len(specs)} leaves)"
+            )
+
     cats = {
         "grad_allreduce": {"payload_bytes": 0.0, "wire_bytes": 0.0},
         "param_allgather": {"payload_bytes": 0.0, "wire_bytes": 0.0},
         "grad_reduce_scatter": {"payload_bytes": 0.0, "wire_bytes": 0.0},
     }
-    for spec, leaf in zip(specs, leaves):
+    if opt_specs is not None:
+        cats["zero1_grad_reduce_scatter"] = {
+            "payload_bytes": 0.0, "wire_bytes": 0.0}
+        cats["zero1_param_allgather"] = {
+            "payload_bytes": 0.0, "wire_bytes": 0.0}
+    for i, (spec, leaf) in enumerate(zip(specs, leaves)):
         shape = tuple(getattr(leaf, "shape", ()))
         count = math.prod(shape) if shape else 1
         p_itemsize = np.dtype(getattr(leaf, "dtype", np.float32)).itemsize
         axes_used = spec_axes(spec)
+        # axes the zero1 opt spec adds beyond the param spec: the grad
+        # all-reduce over them becomes RS + (post-update) param AG
+        z1_deg = 1
+        if opt_specs is not None:
+            for a in spec_axes(opt_specs[i]) - axes_used:
+                z1_deg *= degrees.get(a, 1)
         # fraction of the param each device holds after non-batch-axis
         # sharding (tensor / pipe / expert)
         f_other = 1.0
@@ -566,15 +669,33 @@ def expected_collective_bytes(
         # exclude the expert axis from both paths for those leaves.
         reduce_deg = 1
         zero3_deg = 1
+        z1_axes = (spec_axes(opt_specs[i]) - axes_used
+                   if opt_specs is not None else set())
         for a in batch_axes:
             if a == "expert" and a in axes_used:
                 continue
             if a in axes_used:
                 zero3_deg *= degrees[a]
+            elif a in z1_axes:
+                pass  # replaced by the zero1 RS/AG below
             else:
                 reduce_deg *= degrees[a]
+        grad_payload = count * f_other / max(1, zero3_deg) * grad_itemsize
+        if z1_deg > 1:
+            cats["zero1_grad_reduce_scatter"]["payload_bytes"] += (
+                grad_payload)
+            cats["zero1_grad_reduce_scatter"]["wire_bytes"] += (
+                (z1_deg - 1) / z1_deg * grad_payload
+            )
+            ag = count * f_other / max(1, zero3_deg) * p_itemsize
+            cats["zero1_param_allgather"]["payload_bytes"] += ag
+            cats["zero1_param_allgather"]["wire_bytes"] += (
+                (z1_deg - 1) / z1_deg * ag
+            )
         if reduce_deg > 1:
-            payload = count * f_other / max(1, zero3_deg) * grad_itemsize
+            # any residual reduction (e.g. expert for dense params under
+            # ep) operates on the zero1 shard when one exists
+            payload = grad_payload / z1_deg
             cats["grad_allreduce"]["payload_bytes"] += payload
             cats["grad_allreduce"]["wire_bytes"] += (
                 2 * (reduce_deg - 1) / reduce_deg * payload
@@ -590,9 +711,12 @@ def expected_collective_bytes(
             cats["grad_reduce_scatter"]["wire_bytes"] += (
                 (zero3_deg - 1) / zero3_deg * rs
             )
-    for c in cats.values():
-        c["payload_bytes"] = int(c["payload_bytes"] * grad_accum)
-        c["wire_bytes"] = int(c["wire_bytes"] * grad_accum)
+    for name, c in cats.items():
+        # the zero1 param all-gather happens once per optimizer step,
+        # after accumulation — it does not repeat per slice
+        k = 1 if name == "zero1_param_allgather" else grad_accum
+        c["payload_bytes"] = int(c["payload_bytes"] * k)
+        c["wire_bytes"] = int(c["wire_bytes"] * k)
     model_dependent = {}
     if degrees.get("tensor", 1) > 1:
         model_dependent["tp_activation_allreduce"] = None
@@ -632,6 +756,7 @@ def make_plan(
     pipe: int = 1,
     state_factor: float = 4.0,
     tune_policy: Any = None,
+    zero1: bool = False,
 ) -> ShardPlan:
     """The planner: abstract params + topology -> ShardPlan.
 
@@ -647,6 +772,14 @@ def make_plan(
     ``tune.TunePolicy`` refining the search (batch size, grad-accum
     choices, cache on/off).  Falls back to the ``auto`` heuristic when
     the candidate space is degenerate (e.g. 1 device).
+
+    ``zero1=True`` reshards the optimizer state over the ``data`` axis
+    (ZeRO-1 / cross-replica weight-update sharding, arxiv 2004.13336):
+    the plan gains an ``opt_spec_tree`` distinct from ``param_specs``,
+    and the trainer's update path reduce-scatters grads onto the
+    optimizer shard and all-gathers fresh params.  A no-op when the
+    mesh has no nontrivial ``data`` axis.  Under ``strategy='tuned'``
+    the tuner may also pick a zero1 variant itself.
     """
     known = ("auto", "tuned", "dp", "fsdp", "tp", "tp_fsdp", "ep",
              "ep_fsdp", "ep_tp")
@@ -692,6 +825,7 @@ def make_plan(
                     or tune_mod.TunePolicy(state_factor=state_factor),
                 )
                 resolved, degrees = result.strategy, dict(result.degrees)
+                zero1 = zero1 or bool(getattr(result, "zero1", False))
             else:
                 resolved, degrees = choose_strategy(
                     abstract_params, sub_topo, rules,
@@ -838,10 +972,21 @@ def make_plan(
             pb //= max(1, degrees_final.get("tensor", 1))
             pb //= max(1, degrees_final.get("pipe", 1))
             remat = state_factor * pb > 0.5 * _hbm_bytes(topo.device_kind)
+    opt_spec_tree = None
+    if zero1:
+        opt_spec_tree = zero1_spec_tree(abstract_params, mesh, param_specs)
+        if degrees_final.get("data", 1) <= 1:
+            # no data axis to shard over: the plan is honest about being
+            # a no-op (opt state follows params) but keeps the flag off
+            # so downstream paths don't pay the branch
+            zero1 = False
+            opt_spec_tree = None
     return ShardPlan(
         mesh=mesh,
         strategy=resolved,
         param_specs=param_specs,
         batch_spec=batch_partition_spec(mesh),
         remat=remat,
+        zero1=zero1,
+        opt_spec_tree=opt_spec_tree,
     )

@@ -68,6 +68,57 @@ def opt_state_spec_tree(
     return jax.tree_util.tree_map_with_path(assign, abstract_opt_state)
 
 
+def zero1_update(
+    optimizer: Any,
+    grads: Any,
+    opt_state: Any,
+    params: Any,
+    *,
+    mesh: Any,
+    opt_specs: Any,
+    param_specs: Any,
+) -> tuple[Any, Any]:
+    """ZeRO-1 sharded weight update (arxiv 2004.13336), expressed with
+    sharding constraints only — SimpleFSDP-style (arxiv 2411.00284).
+
+    Inside jit: constrain the grads to the optimizer shard (GSPMD turns
+    the dp grad all-reduce into a reduce-scatter), run the optimizer
+    update locally on the shard, then constrain the fresh params back to
+    their replicated/param specs (GSPMD inserts the all-gather).  No
+    manual collectives — XLA fuses the RS into the backward and the AG
+    into the next forward.
+
+    ``opt_specs`` is a params-structured spec tree (``plan.opt_spec_tree``);
+    returns ``(new_params, new_opt_state)``.
+    """
+    import optax
+    from jax.sharding import NamedSharding
+
+    def shard(tree, specs):
+        spec_flat = jax.tree.leaves(
+            specs, is_leaf=lambda x: isinstance(x, P)
+        )
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        if len(leaves) != len(spec_flat):
+            raise ValueError(
+                f"zero1_update: tree has {len(leaves)} leaves but spec "
+                f"tree has {len(spec_flat)}"
+            )
+        out = [
+            jax.lax.with_sharding_constraint(
+                leaf, NamedSharding(mesh, spec))
+            for leaf, spec in zip(leaves, spec_flat)
+        ]
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    grads = shard(grads, opt_specs)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    updates = shard(updates, opt_specs)
+    params = optax.apply_updates(params, updates)
+    params = shard(params, param_specs)
+    return params, opt_state
+
+
 # ---------------------------------------------------------------------------
 # LR schedules + optimizer presets (the torch.optim.lr_scheduler analog)
 # ---------------------------------------------------------------------------

@@ -38,6 +38,8 @@ _CATEGORY_AXES = {
     "grad_allreduce": ("data", "expert"),
     "param_allgather": ("fsdp",),
     "grad_reduce_scatter": ("fsdp",),
+    "zero1_grad_reduce_scatter": ("data",),
+    "zero1_param_allgather": ("data",),
 }
 
 # Fraction of peak the analytic model assumes achievable (matmul
@@ -59,6 +61,7 @@ class CostEstimate:
             "strategy": self.candidate.strategy,
             "mesh": self.candidate.degrees_dict,
             "grad_accum": self.candidate.grad_accum,
+            "zero1": bool(self.candidate.zero1),
             "step_time_ms": round(self.step_time_s * 1e3, 4),
             "fits": self.fits,
             "breakdown": self.breakdown,
@@ -124,6 +127,7 @@ def score(
     specs = planner.param_spec_tree(
         abstract_params, degrees, cand.strategy, rules
     )
+    zero1 = bool(cand.zero1) and degrees.get("data", 1) > 1
     # abstract plan: mesh is the degrees mapping, which every planner
     # pure function accepts (topology.mesh_degrees)
     plan = planner.ShardPlan(
@@ -132,6 +136,9 @@ def score(
         param_specs=specs,
         batch_spec=planner.batch_partition_spec(degrees),
         remat=remat,
+        zero1=zero1,
+        opt_spec_tree=(planner.zero1_spec_tree(abstract_params, degrees,
+                                               specs) if zero1 else None),
     )
     comm = planner.expected_collective_bytes(
         plan, abstract_params,

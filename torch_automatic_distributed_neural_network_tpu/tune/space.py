@@ -3,7 +3,8 @@
 Enumerates every plan the framework could actually build on this
 topology: divisor splits of the device count across strategies
 (dp / fsdp / tp_fsdp / ep / ep_fsdp) x tensor degree x grad-accum
-choice, then prunes by a per-device memory-fit estimate — params +
+choice x ZeRO-1 optimizer-state sharding (for meshes with a nontrivial
+data axis), then prunes by a per-device memory-fit estimate — params +
 grads + optimizer state through the planner's real ``param_spec_tree``
 sharding math (so indivisible dims that stay replicated are charged
 correctly) plus a coarse activation estimate.
@@ -42,12 +43,14 @@ REMAT_ACT_FACTOR = 0.25
 @dataclasses.dataclass(frozen=True)
 class Candidate:
     """One point of the search space: a strategy, its mesh-axis degrees
-    (only non-trivial axes listed, ordered like MESH_AXES), and a
-    grad-accumulation choice."""
+    (only non-trivial axes listed, ordered like MESH_AXES), a
+    grad-accumulation choice, and whether the optimizer state is
+    ZeRO-1-sharded over the data axis."""
 
     strategy: str
     degrees: tuple[tuple[str, int], ...]
     grad_accum: int = 1
+    zero1: bool = False
 
     @property
     def degrees_dict(self) -> dict[str, int]:
@@ -61,25 +64,29 @@ class Candidate:
     def label(self) -> str:
         mesh = "x".join(f"{ax}{n}" for ax, n in self.degrees if n > 1)
         s = f"{self.strategy}[{mesh or '1'}]"
+        if self.zero1:
+            s += "+z1"
         if self.grad_accum > 1:
             s += f"/ga{self.grad_accum}"
         return s
 
 
-def _degrees_key(strategy: str, degrees: dict[str, int]) -> tuple:
+def _degrees_key(strategy: str, degrees: dict[str, int],
+                 zero1: bool = False) -> tuple:
     return (strategy,
-            tuple(sorted((a, n) for a, n in degrees.items() if n > 1)))
+            tuple(sorted((a, n) for a, n in degrees.items() if n > 1)),
+            bool(zero1))
 
 
 def _as_candidate(strategy: str, degrees: dict[str, int],
-                  grad_accum: int) -> Candidate:
+                  grad_accum: int, zero1: bool = False) -> Candidate:
     ordered = tuple(
         (ax, int(degrees[ax]))
         for ax in topo_mod.MESH_AXES
         if degrees.get(ax, 1) >= 1 and ax in degrees
     )
     return Candidate(strategy=strategy, degrees=ordered,
-                     grad_accum=grad_accum)
+                     grad_accum=grad_accum, zero1=zero1)
 
 
 def estimate_batch_items(batch: Any) -> int:
@@ -182,18 +189,38 @@ def candidate_memory(
     )
     spec_leaves = jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P))
     leaves = jax.tree.leaves(abstract_params)
-    param_b = 0.0
-    total_b = 0.0
-    for spec, leaf in zip(spec_leaves, leaves):
-        shape = tuple(getattr(leaf, "shape", ()))
-        itemsize = np.dtype(getattr(leaf, "dtype", np.float32)).itemsize
-        nbytes = (math.prod(shape) if shape else 1) * itemsize
-        total_b += nbytes
-        frac = 1
-        for ax in planner.spec_axes(spec):
-            frac *= degrees.get(ax, 1)
-        param_b += nbytes / max(1, frac)
-    state_b = state_factor * param_b
+
+    def sharded_bytes(spec_flat):
+        acc = 0.0
+        for spec, leaf in zip(spec_flat, leaves):
+            shape = tuple(getattr(leaf, "shape", ()))
+            itemsize = np.dtype(getattr(leaf, "dtype", np.float32)).itemsize
+            nbytes = (math.prod(shape) if shape else 1) * itemsize
+            frac = 1
+            for ax in planner.spec_axes(spec):
+                frac *= degrees.get(ax, 1)
+            acc += nbytes / max(1, frac)
+        return acc
+
+    total_b = float(sum(
+        (math.prod(tuple(getattr(leaf, "shape", ())) or (1,)))
+        * np.dtype(getattr(leaf, "dtype", np.float32)).itemsize
+        for leaf in leaves
+    ))
+    param_b = sharded_bytes(spec_leaves)
+    if cand.zero1 and degrees.get("data", 1) > 1:
+        # split state_factor: params + grads stay at the param sharding
+        # (factor capped at 2), optimizer moments (the remainder — exact
+        # 2.0 for uniform fp32 adam, conservative for mixed-precision
+        # factors) are charged at the zero1 opt-spec sharding instead
+        opt_tree = planner.zero1_spec_tree(abstract_params, degrees, specs)
+        opt_leaves = jax.tree.leaves(
+            opt_tree, is_leaf=lambda x: isinstance(x, P))
+        moment_factor = max(0.0, state_factor - 2.0)
+        state_b = (min(state_factor, 2.0) * param_b
+                   + moment_factor * sharded_bytes(opt_leaves))
+    else:
+        state_b = state_factor * param_b
     batch_deg = math.prod(
         degrees.get(a, 1) for a in ("data", "fsdp", "expert")
     )
@@ -229,6 +256,7 @@ def enumerate_candidates(
     batch_items: int | None = None,
     safety: float = MEMORY_SAFETY,
     act_profile: dict | None = None,
+    zero1: bool = True,
 ) -> tuple[list[Candidate], list[tuple[Candidate, str]]]:
     """(kept, pruned) candidates for this model on this topology.
 
@@ -267,8 +295,12 @@ def enumerate_candidates(
     kept: list[Candidate] = []
     pruned: list[tuple[Candidate, str]] = []
     for strategy, degrees in raw:
-        for ga in grad_accums:
-            cand = _as_candidate(strategy, degrees, int(ga))
+        # a nontrivial data axis admits a ZeRO-1 variant: same mesh,
+        # optimizer moments sharded over 'data' (arxiv 2004.13336)
+        z1_opts = ((False, True) if zero1 and degrees.get("data", 1) > 1
+                   else (False,))
+        for ga, z1 in ((g, z) for g in grad_accums for z in z1_opts):
+            cand = _as_candidate(strategy, degrees, int(ga), zero1=z1)
             mem = candidate_memory(
                 abstract_params, cand, state_factor=state_factor,
                 batch_items=batch_items, rules=rules,

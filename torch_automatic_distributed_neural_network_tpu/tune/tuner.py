@@ -42,6 +42,11 @@ class TunePolicy:
     safety: float = space_mod.MEMORY_SAFETY
     top_k: int = 3
     use_cache: bool = True
+    # Enumerate ZeRO-1 optimizer-state-sharding variants (space.py adds
+    # a zero1=True twin of every candidate with a nontrivial data axis).
+    # Part of the frozen dataclass, so it hashes into the cache key —
+    # a cached plain-dp decision can never shadow a dp+zero1 search.
+    zero1: bool = True
     # Liveness activation profile of the real traced step
     # (AutoDistribute.activation_profile / analysis.mem_lint) — swaps
     # the coarse activation heuristic for measured liveness intervals
@@ -59,6 +64,7 @@ class TuneResult:
     ranked: list  # list[cost.CostEstimate]; empty on cache hit/fallback
     source: str  # 'cost_model' | 'cache' | 'fallback'
     key: str
+    zero1: bool = False  # winner shards optimizer state over 'data'
 
 
 def tune(
@@ -86,6 +92,7 @@ def tune(
             obs_journal.event(
                 "tune.cache_hit", key=key, strategy=rec["strategy"],
                 mesh=rec.get("degrees"), grad_accum=rec.get("grad_accum", 1),
+                zero1=bool(rec.get("zero1", False)),
                 step_time_ms=rec.get("step_time_ms"),
             )
             return TuneResult(
@@ -94,6 +101,7 @@ def tune(
                          (rec.get("degrees") or {}).items()},
                 grad_accum=int(rec.get("grad_accum", 1)),
                 ranked=[], source="cache", key=key,
+                zero1=bool(rec.get("zero1", False)),
             )
         obs_journal.event("tune.cache_miss", key=key)
 
@@ -102,6 +110,7 @@ def tune(
         grad_accums=policy.grad_accums, max_tensor=policy.max_tensor,
         state_factor=policy.state_factor, batch_items=policy.batch_items,
         safety=policy.safety, act_profile=policy.act_profile,
+        zero1=policy.zero1,
     )
     if topo.num_devices == 1 or len(kept) <= 1:
         # Degenerate space (single chip, or pruning left at most one
@@ -132,6 +141,7 @@ def tune(
         "strategy": win.candidate.strategy,
         "degrees": win.candidate.degrees_dict,
         "grad_accum": win.candidate.grad_accum,
+        "zero1": bool(win.candidate.zero1),
         "step_time_ms": round(win.step_time_s * 1e3, 4),
         "fits": win.fits,
     }
@@ -150,4 +160,5 @@ def tune(
         degrees=win.candidate.degrees_dict,
         grad_accum=win.candidate.grad_accum,
         ranked=ranked, source="cost_model", key=key,
+        zero1=bool(win.candidate.zero1),
     )
