@@ -1,0 +1,220 @@
+#!/usr/bin/env python
+"""Serving load-generator: the SERVE_BENCH_r*.json trajectory.
+
+Drives `inference/serve.ServeEngine` with N concurrent seeded streams
+against a tiny decoder and reports aggregate decode throughput plus the
+latency distribution — the serving analog of bench.py, under the SAME
+freshness-guard contract:
+
+- exactly ONE JSON line on stdout
+  (``{"metric", "value", "unit", "vs_baseline", "extra"}``);
+  everything else goes to stderr;
+- a successful canonical run refreshes ``SERVE_LAST_GOOD.json``
+  (atomic replace, measured_utc + TADNN_BENCH_ROUND);
+- a failed run NEVER replays a previous number — it emits an explicit
+  zero-value ``*_unmeasurable`` record pointing at the last good round
+  (``stale_of``), which ``tadnn report --check`` fails loudly;
+- ``tadnn report --check`` covers ``SERVE_BENCH_r*.json`` the moment
+  the first round is committed (obs/report.check_bench).
+
+The engine itself is backend-agnostic; the canonical capture runs on
+the 8-device CPU sim (metric suffix ``_cpu_sim``) because the serving
+numbers this round exists to track are SCHEDULING numbers — occupancy,
+queue time, iteration-level batching wins — which the sim measures
+honestly.  A TPU-attached run drops the suffix automatically.
+
+Usage (all key=value, bench.py-style):
+
+    python bench_serve.py [streams=8] [slots=4] [prompt_len=12]
+        [max_new=16] [block_size=8] [quant_kv=0] [seed=0]
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+LAST_GOOD_PATH = os.path.join(REPO, "SERVE_LAST_GOOD.json")
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def parse_args():
+    args = {
+        "streams": 8, "slots": 4, "prompt_len": 12, "max_new": 16,
+        "block_size": 8, "max_len": 64, "quant_kv": 0, "seed": 0,
+        "vocab": 128,
+    }
+    for item in sys.argv[1:]:
+        k, _, v = item.partition("=")
+        args[k] = int(v) if v.lstrip("-").isdigit() else v
+    return args
+
+
+def _canonical_argv() -> bool:
+    """Only the bare invocation is the headline (bench.py's rule: debug
+    overrides must neither be saved nor replayed as the headline)."""
+    return not sys.argv[1:]
+
+
+def _load_last_good() -> dict:
+    try:
+        with open(LAST_GOOD_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _save_last_good(result: dict, device_kind: str) -> None:
+    data = _load_last_good()
+    data["serve"] = {
+        "result": result,
+        "measured_utc": datetime.datetime.now(
+            datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ"),
+        "device_kind": device_kind,
+    }
+    rnd = os.environ.get("TADNN_BENCH_ROUND")
+    if rnd:
+        data["serve"]["round"] = rnd
+    tmp = LAST_GOOD_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, LAST_GOOD_PATH)
+
+
+def _pct(sorted_vals, q):
+    import math
+
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[min(len(sorted_vals) - 1,
+                           max(0, math.ceil(q * len(sorted_vals)) - 1))]
+
+
+def run_load(args, journal) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torch_automatic_distributed_neural_network_tpu.inference.serve \
+        import ServeEngine
+    from torch_automatic_distributed_neural_network_tpu.models import GPT2
+
+    model = GPT2("test", vocab_size=int(args["vocab"]),
+                 max_seq_len=int(args["max_len"]), dtype=jnp.float32,
+                 remat=False)
+    rs = np.random.RandomState(int(args["seed"]))
+    prompt0 = rs.randint(1, int(args["vocab"]),
+                         size=(1, int(args["prompt_len"])))
+    variables = model.init(jax.random.key(1),
+                           jnp.asarray(prompt0, jnp.int32))
+
+    eng = ServeEngine(
+        model, variables,
+        n_slots=int(args["slots"]),
+        max_len=int(args["max_len"]),
+        block_size=int(args["block_size"]),
+        quant_kv=bool(int(args["quant_kv"])),
+        journal=journal,
+    )
+    for _ in range(int(args["streams"])):
+        prompt = rs.randint(1, int(args["vocab"]),
+                            size=(int(args["prompt_len"]),))
+        eng.submit([int(t) for t in prompt],
+                   max_new_tokens=int(args["max_new"]), eos_id=0)
+    # warm the decode-step executable outside the timed window: the
+    # first step pays trace+compile, which is not a serving number
+    eng.step()
+    t0 = time.perf_counter()
+    done = eng.run()
+    wall = time.perf_counter() - t0
+
+    totals = sorted((r.t_done or 0.0) - r.t_submit for r in done)
+    new_tokens = sum(r.n_generated for r in done)
+    device_kind = jax.devices()[0].device_kind
+    on_cpu = jax.default_backend() == "cpu"
+    metric = "serve_tokens_per_sec" + ("_cpu_sim" if on_cpu else "")
+    value = new_tokens / max(wall, 1e-9)
+
+    last = (_load_last_good().get("serve") or {}).get("result") or {}
+    vs = (value / last["value"]
+          if last.get("metric") == metric and last.get("value") else 1.0)
+    return {
+        "metric": metric,
+        "value": round(value, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(vs, 4),
+        "extra": {
+            "streams": int(args["streams"]),
+            "slots": int(args["slots"]),
+            "prompt_len": int(args["prompt_len"]),
+            "max_new": int(args["max_new"]),
+            "block_size": int(args["block_size"]),
+            "quant_kv": bool(int(args["quant_kv"])),
+            "n_requests": len(done),
+            "new_tokens": new_tokens,
+            "wall_s": round(wall, 4),
+            "p50_ms": round(_pct(totals, 0.50) * 1e3, 2),
+            "p99_ms": round(_pct(totals, 0.99) * 1e3, 2),
+            "mean_occupancy": (round(eng.mean_occupancy, 4)
+                               if eng.mean_occupancy is not None
+                               else None),
+            "preemptions": eng.scheduler.n_preemptions,
+            "device_kind": device_kind,
+            "backend": jax.default_backend(),
+        },
+    }
+
+
+def main():
+    # serving scheduling numbers are backend-independent; default to the
+    # 8-device CPU sim unless a real accelerator is already visible
+    if not os.environ.get("JAX_PLATFORMS"):
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8").strip()
+    args = parse_args()
+    from torch_automatic_distributed_neural_network_tpu.obs.journal import (
+        Journal,
+    )
+
+    jpath = os.environ.get("TADNN_SERVE_JOURNAL")  # None -> in-memory
+    try:
+        with Journal(jpath, host0_only=False,
+                     meta={"tool": "bench_serve"}) as jnl:
+            result = run_load(args, jnl)
+    except Exception as e:  # noqa: BLE001 — the record IS the report
+        log(f"serve bench failed: {type(e).__name__}: {e}")
+        last = _load_last_good().get("serve")
+        stale_of = (last or {}).get("round") or (
+            last or {}).get("measured_utc")
+        print(json.dumps({
+            "metric": "serve_unmeasurable",
+            "value": 0.0,
+            "unit": "none",
+            "vs_baseline": 0.0,
+            "status": "backend_unreachable",
+            "stale": True,
+            **({"stale_of": stale_of} if stale_of else {}),
+            "extra": {"error": f"{type(e).__name__}: {e}"},
+        }), flush=True)
+        return
+    import jax
+
+    if (result.get("value", 0) > 0
+            and "error" not in (result.get("extra") or {})
+            and _canonical_argv()):
+        _save_last_good(result, jax.devices()[0].device_kind)
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -12,7 +12,9 @@ import torch_automatic_distributed_neural_network_tpu as _pkg
 # subpackages: register the sys.modules alias AND bind the attribute.
 _self = _sys.modules[__name__]
 for _name in ("models", "ops", "parallel", "utils", "data", "training",
-              "obs", "tune", "analysis"):
+              "obs", "tune", "analysis", "inference",
+              "inference.serve"):
     _mod = _importlib.import_module(_pkg.__name__ + "." + _name)
     _sys.modules.setdefault(__name__ + "." + _name, _mod)
-    setattr(_self, _name, _mod)
+    if "." not in _name:
+        setattr(_self, _name, _mod)

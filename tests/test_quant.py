@@ -100,6 +100,43 @@ def test_quantized_generate_runs_and_is_deterministic():
     np.testing.assert_array_equal(np.asarray(a[:, :6]), np.asarray(toks))
 
 
+def test_kv_quantization_error_bound():
+    # int8 KV (serving pool): per-token-per-head scales reduce over
+    # head_dim only, and round-to-nearest keeps |x - deq| <= scale / 2
+    from torch_automatic_distributed_neural_network_tpu.inference.quant \
+        import dequantize_kv, quantize_kv
+
+    x = jax.random.normal(jax.random.key(0), (2, 7, 4, 32), jnp.float32)
+    q = quantize_kv(x)
+    assert is_quantized_leaf(q) and q["q"].dtype == jnp.int8
+    assert q["scale"].shape == (2, 7, 4, 1)
+    deq = dequantize_kv(q, jnp.float32)
+    err = jnp.abs(x - deq)
+    assert float(jnp.max(err - q["scale"] / 2)) <= 1e-6
+
+
+def test_kv_quantization_attention_drift_bounded():
+    # attention over int8-roundtripped K/V must track the dense result:
+    # the serving engine dequantizes on gather, so this IS its numerics
+    from torch_automatic_distributed_neural_network_tpu.inference.quant \
+        import dequantize_kv, quantize_kv
+    from torch_automatic_distributed_neural_network_tpu.ops.attention \
+        import xla_attention
+
+    rng = jax.random.key(1)
+    kq, kk, kv_ = jax.random.split(rng, 3)
+    q = jax.random.normal(kq, (2, 1, 4, 32), jnp.float32)
+    k = jax.random.normal(kk, (2, 16, 4, 32), jnp.float32)
+    v = jax.random.normal(kv_, (2, 16, 4, 32), jnp.float32)
+    dense = xla_attention(q, k, v, causal=False)
+    quant = xla_attention(q, dequantize_kv(quantize_kv(k), jnp.float32),
+                          dequantize_kv(quantize_kv(v), jnp.float32),
+                          causal=False)
+    scale = float(jnp.abs(dense).max())
+    drift = float(jnp.abs(dense - quant).max())
+    assert drift < 0.02 * scale, (drift, scale)
+
+
 def test_double_quantization_is_identity():
     # re-quantizing an already-quantized tree must not touch the leaves
     _, variables = _model_and_vars("gpt2")

@@ -1,0 +1,412 @@
+"""tadnn serve tests: paged-KV allocator and scheduler invariants
+(cheap, host-only — tier-1), continuous-batching token parity with
+sequential generate() on the CPU sim mesh (slow), serving telemetry
+rendering through tadnn report, the serve_estimate capacity lint, and
+the SERVE_BENCH freshness family of check_bench."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torch_automatic_distributed_neural_network_tpu.analysis.serve_lint import (
+    serve_estimate,
+)
+from torch_automatic_distributed_neural_network_tpu.inference import generate
+from torch_automatic_distributed_neural_network_tpu.inference.serve import (
+    BlockAllocator,
+    Request,
+    Scheduler,
+    ServeEngine,
+    blocks_for_tokens,
+)
+from torch_automatic_distributed_neural_network_tpu.models import GPT2
+from torch_automatic_distributed_neural_network_tpu.obs import (
+    report as obs_report,
+)
+
+VOCAB = 128
+
+
+def _model_and_vars(seed=1, p=12):
+    model = GPT2("test", vocab_size=VOCAB, max_seq_len=64,
+                 dtype=jnp.float32, remat=False)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(1, VOCAB, size=(1, p)), jnp.int32)
+    return model, model.init(jax.random.key(seed), tokens)
+
+
+# -- block allocator ----------------------------------------------------------
+
+
+def test_blocks_for_tokens():
+    assert blocks_for_tokens(0, 8) == 1  # even empty holds one block
+    assert blocks_for_tokens(1, 8) == 1
+    assert blocks_for_tokens(8, 8) == 1
+    assert blocks_for_tokens(9, 8) == 2
+    assert blocks_for_tokens(64, 16) == 4
+
+
+def test_allocator_all_or_nothing_and_null_block():
+    a = BlockAllocator(5)  # ids 1..4 allocatable, 0 reserved
+    assert a.n_free == 4
+    got = a.alloc(3)
+    assert got is not None and len(got) == 3 and 0 not in got
+    assert a.alloc(2) is None  # only 1 left: no partial grant
+    assert a.n_free == 1  # the failed alloc took nothing
+    a.free(got)
+    assert a.n_free == 4 and a.n_live == 0
+
+
+def test_allocator_rejects_double_free_and_foreign_ids():
+    a = BlockAllocator(4)
+    got = a.alloc(2)
+    a.free(got)
+    with pytest.raises(ValueError, match="double-free|not currently"):
+        a.free(got)
+    with pytest.raises(ValueError):
+        a.free([0])  # the null block is never live
+
+
+def test_allocator_churn_no_leak():
+    rs = np.random.RandomState(7)
+    a = BlockAllocator(33)
+    held = []
+    for _ in range(500):
+        if held and rs.rand() < 0.5:
+            a.free(held.pop(rs.randint(len(held))))
+        else:
+            got = a.alloc(int(rs.randint(1, 5)))
+            if got is not None:
+                held.append(got)
+        live = {b for blocks in held for b in blocks}
+        assert live == a._live
+        assert a.n_free + len(live) == 32
+    for blocks in held:
+        a.free(blocks)
+    assert a.n_free == 32 and a.n_live == 0
+
+
+# -- scheduler ----------------------------------------------------------------
+
+
+def _mk_sched(num_blocks, n_slots=2, block_size=8, admission="reserve"):
+    alloc = BlockAllocator(num_blocks)
+    return Scheduler(n_slots=n_slots, allocator=alloc,
+                     block_size=block_size, admission=admission)
+
+
+def test_reserve_admission_and_eviction():
+    # each request: 10 prompt + 6 new = 16 tokens = 2 blocks reserved
+    s = _mk_sched(num_blocks=6)  # 5 allocatable -> 2 requests fit
+    reqs = [Request(prompt=[1] * 10, max_new_tokens=6) for _ in range(3)]
+    for r in reqs:
+        s.submit(r)
+    admitted = s.admit()
+    assert [slot for slot, _ in admitted] == [0, 1]
+    assert all(len(r.blocks) == 2 for _, r in admitted)
+    assert s.n_queued == 1 and s.n_active == 2
+    s.check_invariants()
+    # FIFO blocks admission until a slot AND its blocks free up
+    assert s.admit() == []
+    reqs[0].out_tokens = [5] * 6  # finished
+    done = s.evict(0)
+    assert done.state == "done" and not done.blocks
+    admitted = s.admit()
+    assert [r.rid for _, r in admitted] == [reqs[2].rid]
+    s.check_invariants()
+
+
+def test_reserve_admission_gated_by_blocks_not_slots():
+    # 3 allocatable blocks, 2-block reservations: one request at a time
+    # even with both slots empty
+    s = _mk_sched(num_blocks=4)
+    for _ in range(2):
+        s.submit(Request(prompt=[1] * 10, max_new_tokens=6))
+    assert len(s.admit()) == 1
+    assert s.n_queued == 1
+    s.check_invariants()
+
+
+def test_optimistic_grow_and_preemption():
+    # pool of 4 blocks; two 8-token prompts admit at 1 block each, then
+    # growth past the block boundary forces a preemption of the youngest
+    s = _mk_sched(num_blocks=5, block_size=8, admission="optimistic")
+    a, b = (Request(prompt=[1] * 8, max_new_tokens=16, eos_id=None)
+            for _ in range(2))
+    s.submit(a)
+    s.submit(b)
+    admitted = s.admit()
+    assert len(admitted) == 2
+    assert all(len(r.blocks) == 1 for _, r in admitted)
+    # simulate decode until growth needs more than the pool holds:
+    # each grows at 9, 17, 25 tokens -> 2nd and 3rd growth of one of
+    # them must preempt the other (4 allocatable, 3+2 needed)
+    preempted = []
+    for _ in range(20):
+        for r in s.slots:
+            if r is not None:
+                r.out_tokens.append(2)
+        preempted += s.grow_for_step()
+        s.check_invariants()
+        if preempted:
+            break
+    assert preempted, "pool exhaustion never triggered preemption"
+    victim = preempted[0]
+    assert victim.preempted == 1
+    assert victim.state == "queued" and not victim.blocks
+    assert s.queue[0] is victim  # requeued at the FRONT
+    assert s.n_preemptions == 1
+    s.check_invariants()
+
+
+def test_finished_on_eos_and_budget():
+    r = Request(prompt=[1, 2], max_new_tokens=4, eos_id=0)
+    assert not r.finished()
+    r.out_tokens = [5, 0]
+    assert r.finished()  # EOS before budget
+    r2 = Request(prompt=[1, 2], max_new_tokens=2, eos_id=None)
+    r2.out_tokens = [9, 9]
+    assert r2.finished()  # budget exhausted
+
+
+# -- engine: continuous batching vs sequential generate() ---------------------
+
+
+@pytest.mark.slow
+def test_continuous_batching_matches_sequential_generate(devices8):
+    """Token parity: mixed-length requests through 3 slots must emit
+    exactly the tokens greedy generate() emits one request at a time."""
+    model, variables = _model_and_vars()
+    rs = np.random.RandomState(42)
+    prompts = [[int(t) for t in rs.randint(1, VOCAB, size=(p,))]
+               for p in (5, 9, 12, 7, 16)]
+    max_new = 12
+
+    eng = ServeEngine(model, variables, n_slots=3, max_len=64,
+                      block_size=8)
+    reqs = [eng.submit(p, max_new_tokens=max_new, eos_id=0)
+            for p in prompts]
+    done = eng.run()
+    assert len(done) == len(prompts)
+    eng.scheduler.check_invariants()
+    assert eng.pool.allocator.n_live == 0  # every block returned
+
+    for req in reqs:
+        prompt = jnp.asarray(req.prompt, jnp.int32)[None, :]
+        seq, lengths = generate(
+            model, variables, prompt, max_new_tokens=max_new,
+            eos_id=0, early_stop=True, return_lengths=True)
+        n = int(lengths[0]) - len(req.prompt)
+        expect = [int(t) for t in np.asarray(seq[0, len(req.prompt):
+                                                 len(req.prompt) + n])]
+        assert req.out_tokens == expect, (req.rid, req.out_tokens, expect)
+
+
+@pytest.mark.slow
+def test_engine_int8_kv_serves(devices8):
+    model, variables = _model_and_vars()
+    eng = ServeEngine(model, variables, n_slots=2, max_len=64,
+                      block_size=8, quant_kv=True)
+    for p in (6, 11, 9):
+        eng.submit([1] * p, max_new_tokens=6, eos_id=0)
+    done = eng.run()
+    assert len(done) == 3
+    assert all(0 < r.n_generated <= 6 for r in done)
+    assert all(0 <= t < VOCAB for r in done for t in r.out_tokens)
+    eng.scheduler.check_invariants()
+
+
+@pytest.mark.slow
+def test_engine_optimistic_preempts_and_finishes(devices8):
+    # 9 allocatable blocks cannot reserve 4 requests of 24 tokens
+    # (3 blocks each): optimistic admission oversubscribes and preempts
+    model, variables = _model_and_vars()
+    eng = ServeEngine(model, variables, n_slots=4, max_len=32,
+                      block_size=8, num_blocks=10, admission="optimistic")
+    for _ in range(4):
+        eng.submit([3] * 12, max_new_tokens=12, eos_id=None)
+    done = eng.run()
+    assert len(done) == 4
+    assert all(r.n_generated == 12 for r in done)
+    assert eng.scheduler.n_preemptions > 0
+    assert eng.pool.allocator.n_free == 9  # zero leaked blocks
+    eng.scheduler.check_invariants()
+
+
+def test_submit_rejects_impossible_requests():
+    model, variables = _model_and_vars()
+    eng = ServeEngine(model, variables, n_slots=2, max_len=64,
+                      block_size=8, num_blocks=3)
+    with pytest.raises(ValueError, match="exceeds engine max_len"):
+        eng.submit([1] * 60, max_new_tokens=10)
+    with pytest.raises(ValueError, match="empty prompt"):
+        eng.submit([], max_new_tokens=4)
+    with pytest.raises(ValueError, match="pool has"):
+        eng.submit([1] * 30, max_new_tokens=10)  # 5 blocks > 2 usable
+
+
+# -- serving telemetry -> tadnn report ----------------------------------------
+
+
+def test_report_renders_serving_section(tmp_path):
+    jp = tmp_path / "journal.jsonl"
+    recs = [{"kind": "event", "name": "serve.step", "t": 0.1 * i,
+             "step": i, "n_active": 2, "n_queued": 0,
+             "occupancy": 0.5, "free_blocks": 3} for i in range(1, 5)]
+    recs += [
+        {"kind": "event", "name": "serve.request", "t": 0.3, "rid": 0,
+         "n_prompt": 4, "n_new": 6, "queue_s": 0.01, "prefill_s": 0.05,
+         "decode_s": 0.2, "total_s": 0.26, "tokens_per_s": 30.0,
+         "preempted": 0},
+        {"kind": "event", "name": "serve.request", "t": 0.4, "rid": 1,
+         "n_prompt": 2, "n_new": 4, "queue_s": 0.02, "prefill_s": 0.04,
+         "decode_s": 0.3, "total_s": 0.36, "tokens_per_s": 13.3,
+         "preempted": 1},
+    ]
+    with open(jp, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    report = obs_report.generate(str(jp))
+    srv = report["serving"]
+    assert srv["n_requests"] == 2 and srv["n_steps"] == 4
+    assert srv["p50_latency_s"] == 0.26
+    assert srv["p99_latency_s"] == 0.36
+    assert srv["total_new_tokens"] == 10
+    assert srv["mean_occupancy"] == pytest.approx(0.5)
+    assert srv["preemptions"] == 1
+    # goodput over the journal window: 10 tokens / (0.4 - 0.1) s
+    assert srv["goodput_tokens_per_s"] == pytest.approx(10 / 0.3)
+    text = obs_report.format_report(report)
+    assert "serving: 2 request(s)" in text
+    assert "p50" in text and "p99" in text and "goodput" in text
+
+
+@pytest.mark.slow
+def test_engine_journals_render_end_to_end(tmp_path, devices8):
+    from torch_automatic_distributed_neural_network_tpu.obs.journal import (
+        Journal,
+    )
+
+    model, variables = _model_and_vars()
+    jp = tmp_path / "journal.jsonl"
+    with Journal(str(jp), host0_only=False) as jnl:
+        eng = ServeEngine(model, variables, n_slots=2, max_len=64,
+                          block_size=8, journal=jnl)
+        for p in (4, 7):
+            eng.submit([2] * p, max_new_tokens=5, eos_id=0)
+        eng.run()
+    report = obs_report.generate(str(jp))
+    srv = report["serving"]
+    assert srv["n_requests"] == 2
+    assert srv["n_steps"] >= 1
+    assert "p50_latency_s" in srv and "mean_occupancy" in srv
+    assert "serving:" in obs_report.format_report(report)
+
+
+# -- serve_estimate capacity lint ---------------------------------------------
+
+
+def _cfg():
+    return GPT2("test", vocab_size=VOCAB, max_seq_len=64,
+                dtype=jnp.float32, remat=False).cfg
+
+
+def test_serve_estimate_fit_no_findings():
+    findings, est = serve_estimate(_cfg(), budget="64MiB", headroom=0.0,
+                                   block_size=16, max_len=256, streams=8)
+    assert findings == []
+    assert est["max_streams"] >= 8
+    assert est["blocks_per_stream"] == 16
+
+
+def test_serve_estimate_ml005_warns_on_partial_fit():
+    # test cfg: one bf16 block of 16 tokens is 2L*16*4kvH*32hd*2B*2(kv)
+    # = 16 KiB -> 1 MiB holds 64 blocks, 63 usable, 3 full streams
+    findings, est = serve_estimate(_cfg(), budget="1MiB", headroom=0.0,
+                                   block_size=16, max_len=256, streams=8)
+    assert est["block_bytes_per_device"] == 16 * 1024
+    assert est["max_streams"] == 3
+    assert [f.code for f in findings] == ["ML005"]
+    assert findings[0].severity == "warn"
+    assert "--quant-kv" in findings[0].msg
+
+
+def test_serve_estimate_ml004_errors_when_nothing_fits():
+    findings, est = serve_estimate(_cfg(), budget="8KiB", headroom=0.0,
+                                   block_size=16, max_len=256)
+    assert est["max_streams"] == 0
+    assert [f.code for f in findings] == ["ML004"]
+    assert findings[0].severity == "error"
+
+
+def test_serve_estimate_int8_kv_shrinks_blocks():
+    _, dense = serve_estimate(_cfg(), budget="1MiB", headroom=0.0)
+    _, int8 = serve_estimate(_cfg(), budget="1MiB", headroom=0.0,
+                             quant_kv=True)
+    assert int8["block_bytes_per_device"] < dense["block_bytes_per_device"]
+    assert int8["max_streams"] > dense["max_streams"]
+
+
+# -- SERVE bench freshness family ---------------------------------------------
+
+
+def _write(path, obj):
+    with open(path, "w") as f:
+        json.dump(obj, f)
+
+
+def _fresh_bench(tmp_path):
+    _write(tmp_path / "BENCH_r01.json",
+           {"metric": "tokens_per_sec", "value": 100.0})
+    _write(tmp_path / "BENCH_LAST_GOOD.json", {})
+
+
+def test_check_bench_serve_family_not_armed_without_artifacts(tmp_path):
+    _fresh_bench(tmp_path)
+    code, msgs = obs_report.check_bench(str(tmp_path))
+    assert code == 0
+    assert len(msgs) == 1  # no SERVE message before a serving round
+
+
+def test_check_bench_serve_family_fresh(tmp_path):
+    _fresh_bench(tmp_path)
+    # driver round format: bench_serve stdout wrapped under "parsed"
+    _write(tmp_path / "SERVE_BENCH_r01.json",
+           {"n": 1, "cmd": "python bench_serve.py", "rc": 0, "tail": "",
+            "parsed": {"metric": "serve_tokens_per_sec_cpu_sim",
+                       "value": 67.0}})
+    _write(tmp_path / "SERVE_LAST_GOOD.json",
+           {"serve": {"result": {"metric": "serve_tokens_per_sec_cpu_sim",
+                                 "value": 65.0},
+                      "measured_utc": "2026-08-05T00:00:00Z"}})
+    code, msgs = obs_report.check_bench(str(tmp_path))
+    assert code == 0
+    assert any("SERVE_BENCH_r01.json: fresh" in m for m in msgs)
+
+
+def test_check_bench_serve_family_stale_round_fails(tmp_path):
+    _fresh_bench(tmp_path)
+    _write(tmp_path / "SERVE_BENCH_r02.json",
+           {"metric": "serve_unmeasurable", "value": 0.0,
+            "status": "backend_unreachable", "stale": True,
+            "stale_of": "r01"})
+    code, msgs = obs_report.check_bench(str(tmp_path))
+    assert code == 1
+    assert any("stale" in m and "SERVE_BENCH_r02" in m for m in msgs)
+
+
+def test_check_bench_serve_family_regression_fails(tmp_path):
+    _fresh_bench(tmp_path)
+    _write(tmp_path / "SERVE_BENCH_r03.json",
+           {"parsed": {"metric": "serve_tokens_per_sec_cpu_sim",
+                       "value": 10.0}})
+    _write(tmp_path / "SERVE_LAST_GOOD.json",
+           {"serve": {"result": {"metric": "serve_tokens_per_sec_cpu_sim",
+                                 "value": 65.0},
+                      "measured_utc": "2026-08-05T00:00:00Z"}})
+    code, msgs = obs_report.check_bench(str(tmp_path))
+    assert code == 1
+    assert any("regressed" in m for m in msgs)

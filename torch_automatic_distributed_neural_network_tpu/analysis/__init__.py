@@ -125,6 +125,12 @@ RULES: dict[str, RuleInfo] = {
         RuleInfo("ML003", "mem", WARN,
                  "activation-dominated peak with remat off (checkpointing "
                  "would cut it)"),
+        RuleInfo("ML004", "mem", ERROR,
+                 "serving KV pool cannot fit a single concurrent stream "
+                 "under the HBM budget"),
+        RuleInfo("ML005", "mem", WARN,
+                 "serving KV pool fits fewer concurrent streams than "
+                 "requested"),
         RuleInfo("DT001", "dtype", WARN,
                  "unintended f32→bf16/f16 downcast on the loss/optimizer "
                  "path"),

@@ -1,0 +1,134 @@
+"""Serving capacity lint: predict max concurrent streams statically.
+
+``tadnn check --serving`` answers, before any hardware is touched: under
+this chip's HBM budget, how many concurrent streams of ``max_len``
+tokens can the paged KV pool (inference/serve/kv_pool.py) hold?  The
+arithmetic is the same per-device accounting the training memory lint
+uses — the pool pytree is charged through
+:func:`mem_lint.sharded_tree_bytes` under the same head-sharding spec
+``cache_partition_spec`` applies to the live cache — so the static
+number and the runtime allocation agree by construction.
+
+Findings land in the shared Finding/RULES vocabulary: **ML004** (error)
+when not even one stream fits, **ML005** (warn) when fewer fit than the
+deployment asked for.  The full estimate is journaled as
+``lint.serve_estimate`` for ``tadnn report``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from . import ERROR, WARN, Finding
+from .mem_lint import DEFAULT_HEADROOM, _fmt_bytes, resolve_budget
+
+
+def _pool_specs(cfg, degrees: Mapping[str, int], quantize: bool):
+    """Abstract pool pytree + matching PartitionSpec tree for ONE block
+    — kv heads on the tensor axis when divisible, exactly
+    ``cache_partition_spec(cfg, mesh, batch_axes=())``'s rule (restated
+    over a degrees mapping so no mesh object is needed)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    t = int(degrees.get("tensor", 1))
+    head = "tensor" if t > 1 and cfg.kv_heads % t == 0 else None
+    spec = P(None, None, None, head, None)
+
+    def side(block_size):
+        shape = (cfg.n_layers, 1, block_size, cfg.kv_heads, cfg.head_dim)
+        if quantize:
+            return {
+                "q": jax.ShapeDtypeStruct(shape, jnp.int8),
+                "scale": jax.ShapeDtypeStruct(
+                    shape[:-1] + (1,), jnp.float32),
+            }
+        return jax.ShapeDtypeStruct(shape, jnp.bfloat16)
+
+    def side_spec():
+        if quantize:
+            return {"q": spec, "scale": P(None, None, None, head, None)}
+        return spec
+
+    return side, side_spec
+
+
+def serve_estimate(cfg, *,
+                   budget: int | str | None = None,
+                   headroom: float = DEFAULT_HEADROOM,
+                   block_size: int = 16,
+                   max_len: int = 256,
+                   streams: int | None = None,
+                   quant_kv: bool = False,
+                   params_bytes: int = 0,
+                   degrees: Mapping[str, int] | None = None,
+                   ) -> tuple[list[Finding], dict[str, Any]]:
+    """(findings, estimate) for a serving deployment of ``cfg``.
+
+    ``params_bytes`` is charged replicated (the latency-first serving
+    layout); ``degrees`` shards only the KV pool's head axis, matching
+    ``cache_partition_spec``.  ``streams`` is the requested concurrency
+    — when given, fitting fewer is an ML005 warning.
+    """
+    from ..inference.serve.kv_pool import blocks_for_tokens
+    from .mem_lint import sharded_tree_bytes
+
+    degrees = dict(degrees or {})
+    budget_bytes = resolve_budget(budget)
+    side, side_spec = _pool_specs(cfg, degrees, quant_kv)
+    one_block = {"k": side(block_size), "v": side(block_size)}
+    one_spec = {"k": side_spec(), "v": side_spec()}
+    block_bytes_dev, block_bytes_global = sharded_tree_bytes(
+        one_block, one_spec, degrees)
+
+    usable = int(budget_bytes * (1.0 - headroom)) - int(params_bytes)
+    num_blocks = max(0, usable // max(1, block_bytes_dev))
+    blocks_per_stream = blocks_for_tokens(max_len, block_size)
+    # one block is the reserved null block (kv_pool.NULL_BLOCK)
+    max_streams = max(0, (num_blocks - 1) // blocks_per_stream)
+
+    est: dict[str, Any] = {
+        "budget_bytes": int(budget_bytes),
+        "headroom": headroom,
+        "params_bytes": int(params_bytes),
+        "usable_pool_bytes": max(0, usable),
+        "block_size": int(block_size),
+        "block_bytes_per_device": int(block_bytes_dev),
+        "block_bytes_global": int(block_bytes_global),
+        "num_blocks": int(num_blocks),
+        "max_len": int(max_len),
+        "blocks_per_stream": int(blocks_per_stream),
+        "max_streams": int(max_streams),
+        "quant_kv": bool(quant_kv),
+        "degrees": degrees,
+        "requested_streams": streams,
+    }
+
+    findings: list[Finding] = []
+    where = (f"serve[{cfg.n_layers}L x {cfg.kv_heads}kvH x "
+             f"{cfg.head_dim}hd, max_len {max_len}]")
+    if max_streams < 1:
+        findings.append(Finding(
+            "ML004", ERROR, "mem", where,
+            f"KV pool fits 0 streams: {blocks_per_stream} block(s) of "
+            f"{_fmt_bytes(block_bytes_dev)} each exceed the usable "
+            f"{_fmt_bytes(max(0, usable))} "
+            f"(budget {_fmt_bytes(budget_bytes)} less "
+            f"{headroom:.0%} headroom and "
+            f"{_fmt_bytes(params_bytes)} params)"
+            + ("" if quant_kv else "; try --quant-kv (int8 KV ~halves "
+               "block bytes)")))
+    elif streams is not None and max_streams < streams:
+        findings.append(Finding(
+            "ML005", WARN, "mem", where,
+            f"requested {streams} concurrent streams but only "
+            f"{max_streams} fit ({num_blocks} blocks / "
+            f"{blocks_per_stream} per stream)"
+            + ("" if quant_kv else "; --quant-kv (int8 KV) ~doubles "
+               "capacity")))
+
+    from ..obs import journal as obs_journal
+
+    obs_journal.event("lint.serve_estimate", **est)
+    return findings, est

@@ -440,7 +440,9 @@ def cmd_report(args: argparse.Namespace) -> int:
             args.target, bench_path=args.bench,
             last_good_path=args.last_good)
         for m in msgs:
-            print(("FAIL " if code else "ok   ") + m)
+            # per-message verdict: with two trajectories (BENCH + SERVE)
+            # one can be fresh while the other fails the aggregate code
+            print(("ok   " if ": fresh" in m else "FAIL ") + m)
         return code
     if args.merge:
         from .obs import aggregate
@@ -569,6 +571,37 @@ def cmd_check(args: argparse.Namespace) -> int:
             compiled=not args.no_compiled,
         )
         findings += mem_findings
+    serve_est = None
+    if getattr(args, "serving", False):
+        if args.family not in ("gpt2", "llama", "moe"):
+            print("check --serving needs a decoder family "
+                  "(--family gpt2|llama|moe)", file=sys.stderr)
+            return 2
+        import jax
+        import jax.numpy as jnp
+
+        from .analysis import serve_lint
+
+        model, _, _ = _family_setup(args)
+        cfg = model.cfg
+        abstract = jax.eval_shape(
+            lambda r: model.init(
+                r, jnp.zeros((1, min(8, cfg.max_seq_len)), jnp.int32)),
+            jax.random.key(0))
+        params_bytes = sum(
+            leaf.size * leaf.dtype.itemsize
+            for leaf in jax.tree.leaves(abstract))
+        kwargs = {}
+        if args.headroom is not None:
+            kwargs["headroom"] = args.headroom
+        s_findings, serve_est = serve_lint.serve_estimate(
+            cfg, budget=args.budget,
+            block_size=args.serve_block_size,
+            max_len=args.serve_max_len or args.seq or 256,
+            streams=args.serve_streams,
+            quant_kv=args.serve_quant_kv,
+            params_bytes=params_bytes, **kwargs)
+        findings += s_findings
     try:
         findings = analysis.filter_ignored(findings, args.ignore or ())
     except ValueError as e:
@@ -581,15 +614,125 @@ def cmd_check(args: argparse.Namespace) -> int:
                "summary": summary}
         if mem_report is not None:
             out["memory"] = mem_report
+        if serve_est is not None:
+            out["serve_estimate"] = serve_est
         print(json.dumps(out))
     else:
         for f in findings:
             print(f.format())
         if mem_report is not None:
             _print_memory_report(mem_report)
+        if serve_est is not None:
+            print(f"serve estimate: {serve_est['max_streams']} "
+                  f"concurrent stream(s) of {serve_est['max_len']} "
+                  f"tokens ({serve_est['num_blocks']} blocks x "
+                  f"{serve_est['block_size']}, "
+                  f"{'int8' if serve_est['quant_kv'] else 'bf16'} KV)")
         print(f"tadnn check: {summary['errors']} error(s), "
               f"{summary['warnings']} warning(s)")
     return analysis.exit_code(findings, strict=args.strict)
+
+
+def cmd_serve(args: argparse.Namespace) -> int:
+    """Continuous-batching serving loop (inference/serve): build a
+    decoder, spin up the paged-KV ServeEngine, drive it with N seeded
+    streams and print one JSON summary line.  ``--smoke`` pins the tiny
+    CI configuration (test-size model, 8 streams, CPU-friendly); a
+    ``--journal`` path makes the per-request spans renderable by
+    ``tadnn report`` (serving section: p50/p99 latency, goodput, slot
+    occupancy)."""
+    import time
+
+    import numpy as np
+
+    if args.smoke:
+        # the CI smoke contract: tiny model, 8 simulated streams — keep
+        # in sync with tests/test_serve.py and .github/workflows/ci.yml
+        args.family, args.size = "gpt2", "test"
+        args.streams = args.streams or 8
+        args.max_len = args.max_len or 64
+        args.block_size = args.block_size or 8
+        args.max_new = args.max_new or 12
+        args.prompt_len = args.prompt_len or 10
+        args.slots = args.slots or 4
+    if args.family not in ("gpt2", "llama", "moe"):
+        print(f"tadnn serve needs a decoder family (gpt2/llama/moe), "
+              f"got {args.family!r}", file=sys.stderr)
+        return 2
+    import jax
+    import jax.numpy as jnp
+
+    from .inference.serve import ServeEngine
+    from .models import GPT2, Llama, MoE
+    from .obs.journal import Journal
+
+    family = {"gpt2": GPT2, "llama": Llama, "moe": MoE}[args.family]
+    size = args.size or "test"
+    max_len = args.max_len or 256
+    vocab = args.vocab or (128 if size == "test" else None)
+    overrides = {"max_seq_len": max_len, "dtype": jnp.float32,
+                 "remat": False}
+    if vocab:
+        overrides["vocab_size"] = vocab
+    model = family(size, **overrides)
+    cfg = model.cfg
+    rs = np.random.RandomState(args.seed)
+    prompt_len = args.prompt_len or 10
+    sample_tokens = jnp.asarray(
+        rs.randint(1, cfg.vocab_size, size=(1, prompt_len)), jnp.int32)
+    variables = model.init(jax.random.key(1), sample_tokens)
+
+    with Journal(args.journal, host0_only=False,
+                 meta={"tool": "serve"}) as jnl:
+        eng = ServeEngine(
+            model, variables,
+            n_slots=args.slots or 4,
+            max_len=max_len,
+            block_size=args.block_size or 16,
+            quant_kv=args.quant_kv,
+            admission=args.admission,
+            journal=jnl,
+        )
+        streams = args.streams or 8
+        for _ in range(streams):
+            prompt = rs.randint(1, cfg.vocab_size, size=(prompt_len,))
+            eng.submit([int(t) for t in prompt],
+                       max_new_tokens=args.max_new or 12, eos_id=0)
+        t0 = time.monotonic()
+        done = eng.run()
+        wall = time.monotonic() - t0
+        totals = sorted((r.t_done or 0.0) - r.t_submit for r in done)
+        new_tokens = sum(r.n_generated for r in done)
+
+        def pct(vals, q):
+            import math as _m
+
+            return (vals[min(len(vals) - 1,
+                             max(0, _m.ceil(q * len(vals)) - 1))]
+                    if vals else None)
+
+        summary = {
+            "family": args.family, "size": size,
+            "streams": streams, "slots": eng.n_slots,
+            "n_requests": len(done),
+            "new_tokens": new_tokens,
+            "wall_s": round(wall, 4),
+            "tokens_per_s": round(new_tokens / max(wall, 1e-9), 2),
+            "p50_latency_s": pct(totals, 0.50),
+            "p99_latency_s": pct(totals, 0.99),
+            "mean_occupancy": (round(eng.mean_occupancy, 4)
+                               if eng.mean_occupancy is not None
+                               else None),
+            "preemptions": eng.scheduler.n_preemptions,
+            "quant_kv": args.quant_kv,
+            "journal": args.journal,
+        }
+    print(json.dumps(summary))
+    if args.smoke and len(done) != streams:
+        print(f"smoke: expected {streams} finished requests, got "
+              f"{len(done)}", file=sys.stderr)
+        return 1
+    return 0
 
 
 def cmd_tokenize(args: argparse.Namespace) -> int:
@@ -766,6 +909,43 @@ def main(argv: list[str] | None = None) -> int:
     p.set_defaults(fn=cmd_report)
 
     p = sub.add_parser(
+        "serve",
+        help="continuous-batching serving loop (paged KV cache, "
+             "iteration-level scheduler); --smoke pins the tiny CI "
+             "configuration",
+    )
+    p.add_argument("--smoke", action="store_true",
+                   help="CI smoke: test-size model, 8 streams, CPU-ok")
+    p.add_argument("--family", default="gpt2",
+                   help="decoder family: gpt2 | llama | moe")
+    p.add_argument("--size", default=None,
+                   help="model preset (default: test)")
+    p.add_argument("--vocab", type=int, default=None,
+                   help="vocab override (default 128 for test size)")
+    p.add_argument("--streams", type=int, default=None,
+                   help="number of concurrent request streams")
+    p.add_argument("--slots", type=int, default=None,
+                   help="decode slots (batch width of the jitted step)")
+    p.add_argument("--max-len", type=int, default=None, dest="max_len",
+                   help="max tokens per request (prompt + generated)")
+    p.add_argument("--max-new", type=int, default=None, dest="max_new",
+                   help="max generated tokens per request")
+    p.add_argument("--prompt-len", type=int, default=None,
+                   dest="prompt_len")
+    p.add_argument("--block-size", type=int, default=None,
+                   dest="block_size", help="KV pool block size (tokens)")
+    p.add_argument("--quant-kv", action="store_true", dest="quant_kv",
+                   help="int8 KV blocks (inference/quant.quantize_kv)")
+    p.add_argument("--admission", default="reserve",
+                   choices=("reserve", "optimistic"),
+                   help="block admission policy (scheduler.py)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--journal", default=None,
+                   help="journal path for serve.* spans "
+                        "(tadnn report renders them)")
+    p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser(
         "doctor",
         help="verify a checkpoint directory (per-leaf integrity "
              "manifests, resilience.py) and print the fallback chain; "
@@ -834,6 +1014,20 @@ def main(argv: list[str] | None = None) -> int:
                    help="sharding strategy for --memory (default fsdp)")
     p.add_argument("--precision", default="fp32")
     p.add_argument("--grad-accum", type=int, default=1)
+    p.add_argument("--serving", action="store_true",
+                   help="serving capacity lint (analysis/serve_lint): "
+                        "predict max concurrent KV streams under "
+                        "--budget for --family/--size; ML004/ML005")
+    p.add_argument("--serve-streams", type=int, default=None,
+                   dest="serve_streams",
+                   help="requested concurrency (fewer fitting = ML005)")
+    p.add_argument("--serve-block-size", type=int, default=16,
+                   dest="serve_block_size")
+    p.add_argument("--serve-max-len", type=int, default=None,
+                   dest="serve_max_len",
+                   help="tokens per stream (default: --seq or 256)")
+    p.add_argument("--serve-quant-kv", action="store_true",
+                   dest="serve_quant_kv", help="int8 KV pool")
     p.add_argument("--zero1", action="store_true",
                    help="ZeRO-1 for --memory: shard optimizer moments "
                         "over the data axis (the per-chip optimizer row "

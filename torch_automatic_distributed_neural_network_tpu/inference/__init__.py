@@ -1,8 +1,10 @@
-"""Inference: KV-cached autoregressive decoding for the decoder families."""
+"""Inference: KV-cached autoregressive decoding for the decoder
+families, plus the continuous-batching serving subsystem (`.serve`)."""
 
 from .decode import KVCache, SampleConfig, forward_cached, generate
-from .quant import quantize_for_decode
+from .quant import dequantize_kv, quantize_for_decode, quantize_kv
 from .speculative import speculative_generate
 
-__all__ = ["KVCache", "SampleConfig", "forward_cached", "generate",
-           "quantize_for_decode", "speculative_generate"]
+__all__ = ["KVCache", "SampleConfig", "dequantize_kv", "forward_cached",
+           "generate", "quantize_for_decode", "quantize_kv",
+           "speculative_generate"]

@@ -348,7 +348,9 @@ def generate(
     eos_id: int | None = None,
     moe_decode: str = "dense",
     moe_capacity: int | None = None,
-) -> jax.Array:
+    early_stop: bool = False,
+    return_lengths: bool = False,
+) -> jax.Array | tuple[jax.Array, jax.Array]:
     """Autoregressive generation: prefill + one-token lax.scan decode.
 
     Returns [B, P + max_new_tokens].  The whole loop compiles to a single
@@ -361,13 +363,30 @@ def generate(
     ``eos_id``: once a row samples it, every later position in that row
     is ``eos_id`` (the output stays fixed-shape — XLA needs static trip
     counts — but rows are individually final after their EOS).
+
+    ``early_stop=True`` (requires ``eos_id``) swaps the scan for a
+    ``lax.while_loop`` that exits as soon as EVERY row has sampled its
+    EOS — a batch of short answers stops paying per-token steps once the
+    longest row finishes instead of running to ``max_new_tokens``.  The
+    output is bit-identical to the scan path (same pre-split step keys,
+    same eos-fill: unreached positions hold ``eos_id``) but the returned
+    buffer shape stays [B, P + max_new_tokens] — XLA outputs are static.
+
+    ``return_lengths=True`` additionally returns per-row valid lengths
+    [B] int32: prompt + generated tokens up to and INCLUDING the first
+    EOS (or ``P + max_new_tokens`` for rows that never sampled it) —
+    ``out[i, :lengths[i]]`` is row i's real content, the rest is fill.
     """
     if sample is None:
         sample = SampleConfig(temperature=0.0)
+    if early_stop and eos_id is None:
+        raise ValueError("early_stop=True requires eos_id")
     cfg: TransformerConfig = model.cfg
     params = variables["params"]
     B, P = prompt.shape
     if max_new_tokens < 1:
+        if return_lengths:
+            return prompt, jnp.full((B,), P, jnp.int32)
         return prompt
     rng = jax.random.key(0) if rng is None else rng
     rng, first_rng = jax.random.split(rng)
@@ -406,7 +425,32 @@ def generate(
             done = jnp.logical_or(done, nxt == eos_id)
         return (cache, nxt, done), nxt
 
-    if max_new_tokens > 1:
+    if max_new_tokens > 1 and early_stop:
+        # while_loop variant: same body, same PRE-SPLIT step keys (key
+        # i is consumed at step i whether or not earlier rows stopped,
+        # so sampled outputs match the scan path exactly); positions a
+        # finished batch never reaches keep their eos_id buffer fill —
+        # identical to what the scan's done-row clamp would have written
+        step_keys = jax.random.split(rng, max_new_tokens - 1)
+        buf0 = jnp.full((B, max_new_tokens), eos_id, jnp.int32)
+        buf0 = buf0.at[:, 0].set(first)
+
+        def w_cond(carry):
+            _, _, done, step, _ = carry
+            return (step < max_new_tokens - 1) & ~jnp.all(done)
+
+        def w_body(carry):
+            cache, tok, done, step, buf = carry
+            (cache, nxt, done), _ = body((cache, tok, done),
+                                         step_keys[step])
+            buf = buf.at[:, step + 1].set(nxt)
+            return cache, nxt, done, step + 1, buf
+
+        *_, new_tokens = jax.lax.while_loop(
+            w_cond, w_body,
+            (cache, first, done0, jnp.zeros((), jnp.int32), buf0),
+        )
+    elif max_new_tokens > 1:
         (_, _, _), rest = jax.lax.scan(
             body, (cache, first, done0),
             jax.random.split(rng, max_new_tokens - 1),
@@ -414,4 +458,14 @@ def generate(
         new_tokens = jnp.concatenate([first[:, None], rest.T], axis=1)
     else:
         new_tokens = first[:, None]
-    return jnp.concatenate([prompt, new_tokens], axis=1)
+    out = jnp.concatenate([prompt, new_tokens], axis=1)
+    if not return_lengths:
+        return out
+    if eos_id is None:
+        lengths = jnp.full((B,), P + max_new_tokens, jnp.int32)
+    else:
+        is_eos = new_tokens == eos_id
+        hit = is_eos.any(axis=1)
+        first_eos = jnp.argmax(is_eos, axis=1).astype(jnp.int32)
+        lengths = P + jnp.where(hit, first_eos + 1, max_new_tokens)
+    return out, lengths.astype(jnp.int32)

@@ -102,6 +102,25 @@ def dequantize_tree(tree, dtype=jnp.bfloat16):
     return tree
 
 
+def quantize_kv(x):
+    """int8 KV storage with per-token-per-head scales.
+
+    ``x`` is any KV tensor whose LAST axis is head_dim (a [.., kvH, hd]
+    cache block, a single written token, a whole pooled cache).  The
+    scale reduces over head_dim only — one fp32 scale per (token, head)
+    — so a loud head cannot crush a quiet head's resolution and each
+    token requantizes independently when written into a paged block.
+    Same symmetric scheme and ``{"q", "scale"}`` leaf convention as the
+    weight path, so ``is_quantized_leaf``/``dequantize_leaf`` apply.
+    """
+    return _quantize(x, (jnp.ndim(x) - 1,))
+
+
+def dequantize_kv(qkv, dtype=jnp.bfloat16):
+    """{"q", "scale"} KV leaf -> dense [.., kvH, hd] in ``dtype``."""
+    return dequantize_leaf(qkv, dtype)
+
+
 def embedding_lookup(emb, tokens, dtype=jnp.bfloat16):
     """Gather-then-dequantize: only the LOOKED-UP rows convert, the
     [V, d] table itself stays int8 in HBM."""

@@ -1,0 +1,27 @@
+"""Continuous-batching serving: paged KV cache, iteration-level
+scheduler, slot-padded jitted decode engine (`tadnn serve`)."""
+
+from .engine import ServeEngine
+from .kv_pool import (
+    NULL_BLOCK,
+    BlockAllocator,
+    PagedKVPool,
+    blocks_for_tokens,
+    gather_blocks,
+    pool_kv_bytes,
+    write_token,
+)
+from .scheduler import Request, Scheduler
+
+__all__ = [
+    "NULL_BLOCK",
+    "BlockAllocator",
+    "PagedKVPool",
+    "Request",
+    "Scheduler",
+    "ServeEngine",
+    "blocks_for_tokens",
+    "gather_blocks",
+    "pool_kv_bytes",
+    "write_token",
+]

@@ -1,0 +1,333 @@
+"""Serving engine: persistent jitted decode over a slot-padded batch.
+
+One fixed-shape decode step serves every live request at once.  The
+batch axis is ``n_slots`` *slots*, not requests: a slot is either bound
+to a running request or inactive (null block table, masked sampling).
+Each call advances EVERY active request by one token; between calls the
+scheduler evicts finished requests and admits queued ones, so the step
+executable compiles once and runs for the life of the server — no
+recompiles as the request mix churns (prefill is the only shape-varying
+entry point, one trace per distinct prompt length).
+
+Per-layer math is the TRAINING modules applied piecewise — the same
+single-source-of-truth discipline as ``decode.forward_cached``, from
+which this step differs in exactly three ways:
+
+- positions/lengths are PER-SLOT vectors (requests at different depths
+  share a step), so rope angles and the attention mask row vary by slot;
+- KV reads/writes go through the paged pool (``kv_pool.gather_blocks``
+  / ``write_token``) instead of a contiguous cache strip;
+- sampled tokens are masked to 0 on inactive slots.
+
+Prefill reuses ``forward_cached`` itself on a [1, P] dense temp cache,
+then copies the rows into the request's blocks — numerically the exact
+prefill ``generate()`` runs, which is what makes token-parity with
+sequential generation testable (greedy decoding is deterministic; for
+stochastic sampling the engine is reproducible under its own rng but
+not per-request-identical to ``generate()``, since one categorical
+call samples all slots).
+
+Telemetry: every finished request journals a ``serve.request`` event
+(queue/prefill/decode/total seconds, tokens/s, preemption count) and
+every step a ``serve.step`` event (slot occupancy, free blocks) through
+``obs.journal`` — ``tadnn report`` renders p50/p99 latency, goodput
+and occupancy from exactly these records.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...models.transformer_core import (
+    MLPBlock,
+    SelfAttention,
+    TransformerConfig,
+    make_norm,
+)
+from ...obs import journal as _journal
+from ..decode import (
+    KVCache,
+    SampleConfig,
+    _moe_mlp_cached,
+    _sample,
+    forward_cached,
+)
+from ..quant import dequantize_leaf, dequantize_tree, embedding_lookup, \
+    is_quantized_leaf
+from .kv_pool import (
+    PagedKVPool,
+    blocks_for_tokens,
+    gather_blocks,
+    write_token,
+)
+from .scheduler import Request, Scheduler
+
+
+def _paged_decode_step(params, kv, tables, ctx_lens, last_tok, active,
+                       rng, *, cfg: TransformerConfig,
+                       sample: SampleConfig, moe_decode: str,
+                       mesh=None, spec=None):
+    """One token for every slot.  [S] vectors throughout; static shapes
+    (S slots, tables [S, max_blocks]) so this traces exactly once."""
+    from ...ops.attention import xla_attention
+
+    dtype = cfg.dtype
+    norm = make_norm(cfg)
+    attn = SelfAttention(cfg)
+    mlp = MLPBlock(cfg)
+    if mesh is not None and spec is not None:
+        from jax.sharding import NamedSharding
+
+        sh = NamedSharding(mesh, spec)
+        kv = jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(x, sh), kv)
+
+    x = embedding_lookup(
+        params["embed"]["embedding"], last_tok[:, None], dtype)  # [S,1,d]
+    positions = ctx_lens[:, None]  # [S, 1] — per-slot rope angles
+    if cfg.pos == "learned":
+        pe = params["pos_embed"].astype(dtype)
+        x = x + pe[positions]
+
+    n_keys = tables.shape[1] * (
+        kv["k"]["q"] if is_quantized_leaf(kv["k"]) else kv["k"]
+    ).shape[2]
+    key_idx = jnp.arange(n_keys)[None, :]
+    # the step writes this token at ctx_lens, then attends keys
+    # 0..ctx_lens inclusive; table padding beyond a slot's blocks
+    # gathers null-block garbage that this mask never admits
+    mask = key_idx <= ctx_lens[:, None]
+    if cfg.sliding_window is not None:
+        mask &= key_idx > ctx_lens[:, None] - cfg.sliding_window
+    mask = mask[:, None, None, :]  # [S, 1, 1, K]
+
+    def layer(x, xs):
+        lp, k_layer, v_layer = xs
+        lp = dequantize_tree(lp, dtype)
+        h = norm.apply({"params": lp["attn_norm"]}, x)
+        q, k, v = attn.apply(
+            {"params": lp["attn"]}, h, positions, method="qkv")
+        k_layer = write_token(k_layer, tables, ctx_lens, k[:, 0])
+        v_layer = write_token(v_layer, tables, ctx_lens, v[:, 0])
+        kd = gather_blocks(k_layer, tables, dtype)
+        vd = gather_blocks(v_layer, tables, dtype)
+        o = xla_attention(q, kd, vd, causal=False, mask=mask)
+        x = x + attn.apply(
+            {"params": lp["attn"]}, o.astype(dtype), method="out_proj")
+        h = norm.apply({"params": lp["mlp_norm"]}, x)
+        if "experts_up" in lp["mlp"]:
+            x = x + _moe_mlp_cached(lp["mlp"], h, cfg)
+        else:
+            x = x + mlp.apply({"params": lp["mlp"]}, h)
+        return x, (k_layer, v_layer)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer, x, (params["layers"], kv["k"], kv["v"]))
+
+    x = norm.apply({"params": params["final_norm"]}, x)
+    feats = x[:, -1].astype(jnp.float32)
+    if cfg.tie_embeddings:
+        emb = params["embed"]["embedding"]
+        if is_quantized_leaf(emb):
+            emb = dequantize_leaf(emb, jnp.float32)
+        logits = feats @ emb.astype(jnp.float32).T
+    else:
+        head = params["lm_head"]["kernel"]
+        if is_quantized_leaf(head):
+            head = dequantize_leaf(head, jnp.float32)
+        logits = feats @ head.astype(jnp.float32)
+    nxt = _sample(logits, rng, sample)
+    nxt = jnp.where(active, nxt, 0)
+    return {"k": new_k, "v": new_v}, nxt
+
+
+class ServeEngine:
+    """Continuous-batching server over a model + paged KV pool.
+
+        eng = ServeEngine(model, variables, n_slots=8, max_len=256)
+        eng.submit([1, 2, 3], max_new_tokens=32, eos_id=0)
+        done = eng.run()          # [Request] with .prompt + .out_tokens
+
+    ``submit`` is non-blocking (requests queue); ``step()`` advances the
+    world by one decode iteration (evict / admit+prefill / grow /
+    decode); ``run()`` steps until idle.  A long-lived server calls
+    ``submit`` from its frontend and ``step`` in a loop — nothing here
+    blocks on a full batch.
+    """
+
+    def __init__(self, model, variables: Any, *,
+                 n_slots: int = 8,
+                 max_len: int = 256,
+                 block_size: int = 16,
+                 num_blocks: int | None = None,
+                 quant_kv: bool = False,
+                 cache_dtype=jnp.bfloat16,
+                 sample: SampleConfig | None = None,
+                 admission: str = "reserve",
+                 moe_decode: str = "dense",
+                 mesh=None,
+                 rng: jax.Array | None = None,
+                 journal: Any = None):
+        self.cfg: TransformerConfig = model.cfg
+        self.params = variables["params"]
+        self.sample = sample or SampleConfig(temperature=0.0)
+        self.n_slots = n_slots
+        self.max_len = max_len
+        self.moe_decode = moe_decode
+        self.mesh = mesh
+        self.max_blocks = blocks_for_tokens(max_len, block_size)
+        if num_blocks is None:
+            # worst case every slot full-length, plus the null block
+            num_blocks = n_slots * self.max_blocks + 1
+        self.pool = PagedKVPool(
+            self.cfg, num_blocks=num_blocks, block_size=block_size,
+            dtype=cache_dtype, quantize=quant_kv, mesh=mesh)
+        self.scheduler = Scheduler(
+            n_slots=n_slots, allocator=self.pool.allocator,
+            block_size=block_size, admission=admission)
+        self.journal = journal or _journal.get_default()
+        self._rng = jax.random.key(0) if rng is None else rng
+        self._step_count = 0
+        self._occupancy_sum = 0.0
+        self.finished: list[Request] = []
+        self._step_fn = jax.jit(
+            partial(_paged_decode_step, cfg=self.cfg, sample=self.sample,
+                    moe_decode=moe_decode, mesh=mesh, spec=self.pool.spec),
+            donate_argnums=(1,))
+
+    # -- request intake ------------------------------------------------------
+
+    def submit(self, prompt: list[int], max_new_tokens: int,
+               eos_id: int | None = None) -> Request:
+        total = len(prompt) + max_new_tokens
+        if total > self.max_len:
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new_tokens {max_new_tokens} "
+                f"= {total} exceeds engine max_len {self.max_len}")
+        if not prompt:
+            raise ValueError("empty prompt")
+        need = blocks_for_tokens(total, self.pool.block_size)
+        if need > self.pool.num_blocks - 1:
+            # the pool could NEVER cover this request even alone —
+            # admitting it would preempt-thrash forever in optimistic
+            # mode and deadlock admission in reserve mode
+            raise ValueError(
+                f"request needs {need} blocks but the pool has "
+                f"{self.pool.num_blocks - 1} allocatable")
+        req = Request(prompt=list(map(int, prompt)),
+                      max_new_tokens=max_new_tokens, eos_id=eos_id)
+        self.scheduler.submit(req)
+        return req
+
+    # -- one serving iteration ----------------------------------------------
+
+    def _prefill_into_slot(self, slot: int, req: Request) -> None:
+        tokens = jnp.asarray(req.prompt, jnp.int32)[None, :]
+        cache = KVCache.init(self.cfg, 1, tokens.shape[1],
+                             dtype=jnp.bfloat16)
+        # forward_cached retraces per distinct prompt length — the only
+        # shape-varying compile in the serving loop
+        logits, cache = forward_cached(
+            self.params, self.cfg, tokens, cache,
+            moe_decode=self.moe_decode, mesh=None)
+        req_rng = jax.random.fold_in(self._rng, req.rid)
+        _, first_rng = jax.random.split(req_rng)
+        first = int(jax.device_get(
+            _sample(logits, first_rng, self.sample))[0])
+        self.pool.write_prefill(req.blocks[:blocks_for_tokens(
+            req.n_prompt, self.pool.block_size)],
+            cache.k[:, 0], cache.v[:, 0])
+        req.out_tokens = [first]
+        req.t_first_token = time.monotonic()
+
+    def _decode_all(self) -> None:
+        S, MB = self.n_slots, self.max_blocks
+        tables = np.zeros((S, MB), np.int32)
+        ctx = np.zeros((S,), np.int32)
+        last = np.zeros((S,), np.int32)
+        act = np.zeros((S,), bool)
+        for s, req in enumerate(self.scheduler.slots):
+            if req is None:
+                continue
+            tables[s, :len(req.blocks)] = req.blocks
+            # this step writes token n_generated at absolute position
+            # n_prompt + n_generated - 1 (the first generated token
+            # came from prefill and was never written)
+            ctx[s] = req.n_prompt + req.n_generated - 1
+            last[s] = req.out_tokens[-1]
+            act[s] = True
+        step_rng = jax.random.fold_in(self._rng, 2**20 + self._step_count)
+        self.pool.kv, nxt = self._step_fn(
+            self.params, self.pool.kv, jnp.asarray(tables),
+            jnp.asarray(ctx), jnp.asarray(last), jnp.asarray(act),
+            step_rng)
+        nxt = np.asarray(jax.device_get(nxt))
+        for s, req in enumerate(self.scheduler.slots):
+            if req is not None:
+                req.out_tokens.append(int(nxt[s]))
+
+    def _finish(self, slot: int) -> None:
+        req = self.scheduler.evict(slot)
+        self.finished.append(req)
+        if self.journal is None:
+            return
+        queue_s = (req.t_admit or req.t_submit) - req.t_submit
+        prefill_s = ((req.t_first_token - req.t_admit)
+                     if req.t_first_token and req.t_admit else None)
+        decode_s = ((req.t_done - req.t_first_token)
+                    if req.t_first_token else None)
+        total_s = req.t_done - req.t_submit
+        self.journal.event(
+            "serve.request", rid=req.rid, n_prompt=req.n_prompt,
+            n_new=req.n_generated, queue_s=queue_s,
+            prefill_s=prefill_s, decode_s=decode_s, total_s=total_s,
+            tokens_per_s=(req.n_generated / decode_s
+                          if decode_s else None),
+            preempted=req.preempted)
+
+    def step(self) -> None:
+        """One serving iteration: evict finished, admit+prefill queued,
+        grow/preempt (optimistic), decode every active slot."""
+        sched = self.scheduler
+        for s in range(self.n_slots):
+            req = sched.slots[s]
+            if req is not None and req.finished():
+                self._finish(s)
+        for slot, req in sched.admit():
+            self._prefill_into_slot(slot, req)
+            if req.finished():  # max_new_tokens == 1
+                self._finish(slot)
+        for victim in sched.grow_for_step():
+            if self.journal is not None:
+                self.journal.event("serve.preempt", rid=victim.rid,
+                                   n_regenerate=victim.n_prompt)
+        if sched.n_active:
+            self._decode_all()
+        self._step_count += 1
+        self._occupancy_sum += sched.n_active / self.n_slots
+        if self.journal is not None:
+            self.journal.event(
+                "serve.step", step=self._step_count,
+                n_active=sched.n_active, n_queued=sched.n_queued,
+                occupancy=sched.n_active / self.n_slots,
+                free_blocks=self.pool.allocator.n_free)
+
+    @property
+    def mean_occupancy(self) -> float | None:
+        """Mean active-slot fraction over every step so far."""
+        if not self._step_count:
+            return None
+        return self._occupancy_sum / self._step_count
+
+    def run(self) -> list[Request]:
+        """Step until queue and slots drain; returns finished requests
+        (every submitted request, in completion order)."""
+        while not self.scheduler.idle():
+            self.step()
+        return list(self.finished)

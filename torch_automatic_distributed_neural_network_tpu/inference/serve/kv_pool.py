@@ -1,0 +1,269 @@
+"""Paged KV cache: block-granular storage with per-request block tables.
+
+``decode.KVCache`` reserves a contiguous [B, S_max] strip per row, so a
+batch of mixed-length requests pays worst-case memory for every slot.
+Serving flips that: the pool owns ONE block-granular store per layer,
+
+    k, v: [L, num_blocks, block_size, kvH, hd]
+
+and each live request holds an ordered list of block ids (its *block
+table*).  Token ``p`` of a request lives at ``(table[p // bs], p % bs)``
+— the classic paged layout.  Memory is O(tokens actually cached), blocks
+return to the free list the step a request finishes, and a new prefill
+can reuse them immediately (iteration-level batching never drains).
+
+Block 0 is the **null block**: never allocated, never read through an
+active mask.  Inactive decode slots keep a table of zeros, so the fully
+vectorized slot-padded decode step can scatter their (garbage) token
+writes somewhere harmless without per-slot branching.
+
+int8 mode (``quantize=True``) stores ``{"q": int8, "scale": fp32}``
+per side via :func:`..quant.quantize_kv` — per-token-per-head scales,
+written at the same (block, offset) the token lands in, so a block's
+tokens quantize independently and freeing/reusing a block needs no
+scale bookkeeping.  ~2x KV capacity per byte of HBM; the numerics bound
+is pinned in tests/test_quant.py.
+
+Reads inside the jitted decode step go through :func:`gather_blocks`
+(table-indexed gather to a dense [S, max_len, kvH, hd] view feeding the
+stock ``xla_attention``).  That is the correctness-first choice — a
+fused paged-attention kernel that never materializes the gathered view
+is the known follow-up (ROADMAP), not a prerequisite: on the CPU sim
+mesh and at smoke scale the gather is XLA-fused and exact.
+
+Sharding: the pool leaf spec is ``cache_partition_spec`` with NO batch
+axes (blocks are a global resource, any slot may use any block) — kv
+heads split over the tensor axis exactly like the dense decode cache.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ...models.transformer_core import TransformerConfig
+from ..decode import cache_partition_spec
+from ..quant import is_quantized_leaf, quantize_kv
+
+NULL_BLOCK = 0  # reserved scratch target for inactive-slot writes
+
+
+def blocks_for_tokens(n_tokens: int, block_size: int) -> int:
+    """Blocks needed to hold ``n_tokens`` cache entries."""
+    return max(1, math.ceil(n_tokens / block_size))
+
+
+class BlockAllocator:
+    """Free-list allocator over ``num_blocks`` block ids.
+
+    Block 0 (:data:`NULL_BLOCK`) is reserved and never handed out.
+    ``alloc`` is all-or-nothing (returns None rather than a partial
+    grant — admission control wants a clean fit check), ``free`` rejects
+    double-frees and foreign ids loudly: a block on two tables at once
+    is silent cross-request cache corruption, the one failure mode a
+    paged cache must make impossible.
+    """
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"need >= 2 blocks (one is the reserved null block), "
+                f"got {num_blocks}")
+        self.num_blocks = num_blocks
+        # LIFO free list: recently-freed blocks are re-used first (their
+        # pool pages are the ones still warm in cache on real hardware)
+        self._free = list(range(num_blocks - 1, 0, -1))
+        self._live: set[int] = set()
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_live(self) -> int:
+        return len(self._live)
+
+    def alloc(self, n: int) -> list[int] | None:
+        """``n`` block ids, or None if the pool cannot cover them."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        got = [self._free.pop() for _ in range(n)]
+        self._live.update(got)
+        return got
+
+    def free(self, blocks: list[int]) -> None:
+        for b in blocks:
+            if b not in self._live:
+                raise ValueError(
+                    f"free of block {b} not currently allocated "
+                    f"(double-free or foreign id)")
+            self._live.remove(b)
+            self._free.append(b)
+
+
+def pool_kv_bytes(cfg: TransformerConfig, num_blocks: int, block_size: int,
+                  dtype=jnp.bfloat16, quantize: bool = False) -> int:
+    """Global bytes of the k+v pool arrays (scales included in int8
+    mode) — the static number admission control and `check --serving`
+    budget against."""
+    n_cells = cfg.n_layers * num_blocks * block_size * cfg.kv_heads
+    if quantize:
+        per_cell = cfg.head_dim * 1 + 4  # int8 payload + fp32 scale
+    else:
+        per_cell = cfg.head_dim * jnp.dtype(dtype).itemsize
+    return 2 * n_cells * per_cell  # k and v
+
+
+def _zeros_side(shape, dtype, quantize: bool):
+    if not quantize:
+        return jnp.zeros(shape, dtype)
+    return {
+        "q": jnp.zeros(shape, jnp.int8),
+        "scale": jnp.ones(shape[:-1] + (1,), jnp.float32),
+    }
+
+
+def gather_blocks(kv_layer: Any, table: jax.Array,
+                  dtype=jnp.bfloat16) -> jax.Array:
+    """Dense per-slot view of one layer's paged KV.
+
+    ``kv_layer``: [NB, bs, kvH, hd] (or its ``{"q","scale"}`` int8
+    form); ``table``: [S, max_blocks] int32 —> [S, max_blocks*bs, kvH,
+    hd].  Table rows are padded with :data:`NULL_BLOCK`; the garbage
+    gathered from those pages sits beyond each slot's context length
+    and the attention mask never admits it.  Dequantize-on-gather keeps
+    the int8 arrays as what lives in HBM (same contract as the weight
+    path) — only the gathered working set converts.
+    """
+    if is_quantized_leaf(kv_layer):
+        g = (kv_layer["q"][table].astype(jnp.float32)
+             * kv_layer["scale"][table]).astype(dtype)
+    else:
+        g = kv_layer[table].astype(dtype)
+    S, MB, bs, H, hd = g.shape
+    return g.reshape(S, MB * bs, H, hd)
+
+
+def write_token(kv_layer: Any, table: jax.Array, pos: jax.Array,
+                new: jax.Array) -> Any:
+    """Scatter one token per slot into its paged position.
+
+    ``new``: [S, kvH, hd] (this step's k or v), ``pos``: [S] absolute
+    context positions.  The target is ``(table[s, pos // bs], pos % bs)``
+    per slot; inactive slots carry all-null tables so their writes land
+    in the scratch block.  int8 mode quantizes the token in place with
+    its own per-head scale.
+    """
+    bs = (kv_layer["q"] if is_quantized_leaf(kv_layer)
+          else kv_layer).shape[1]
+    S = table.shape[0]
+    blk = jnp.take_along_axis(
+        table, (pos // bs)[:, None].astype(jnp.int32), axis=1)[:, 0]
+    off = pos % bs
+    if is_quantized_leaf(kv_layer):
+        q = quantize_kv(new)
+        return {
+            "q": kv_layer["q"].at[blk, off].set(q["q"]),
+            "scale": kv_layer["scale"].at[blk, off].set(q["scale"]),
+        }
+    return kv_layer.at[blk, off].set(new.astype(kv_layer.dtype))
+
+
+class PagedKVPool:
+    """Device storage + allocator + host-side table building.
+
+    The arrays live as a pytree ``{"k": .., "v": ..}`` with leading
+    layer axis on every leaf so the engine's ``lax.scan`` over layers
+    threads them exactly like ``forward_cached`` threads the dense
+    cache.  The pool object itself is host state (free list, shapes);
+    the arrays are swapped wholesale through the jitted step (donated),
+    so there is no device<->host copy per token.
+    """
+
+    def __init__(self, cfg: TransformerConfig, *, num_blocks: int,
+                 block_size: int, dtype=jnp.bfloat16,
+                 quantize: bool = False, mesh=None):
+        self.cfg = cfg
+        self.block_size = int(block_size)
+        self.dtype = dtype
+        self.quantize = bool(quantize)
+        self.allocator = BlockAllocator(num_blocks)
+        shape = (cfg.n_layers, num_blocks, block_size,
+                 cfg.kv_heads, cfg.head_dim)
+        self.kv = {"k": _zeros_side(shape, dtype, quantize),
+                   "v": _zeros_side(shape, dtype, quantize)}
+        self.spec = None
+        if mesh is not None:
+            self.spec = cache_partition_spec(cfg, mesh, batch_axes=())
+            from jax.sharding import NamedSharding
+
+            sh = NamedSharding(mesh, self.spec)
+
+            def place(x):
+                return jax.device_put(x, sh)
+
+            self.kv = {
+                side: ({"q": place(leaf["q"]),
+                        "scale": place(leaf["scale"])}
+                       if is_quantized_leaf(leaf) else place(leaf))
+                for side, leaf in self.kv.items()
+            }
+
+    @property
+    def num_blocks(self) -> int:
+        return self.allocator.num_blocks
+
+    @property
+    def total_bytes(self) -> int:
+        return pool_kv_bytes(self.cfg, self.num_blocks, self.block_size,
+                             self.dtype, self.quantize)
+
+    def alloc(self, n: int) -> list[int] | None:
+        return self.allocator.alloc(n)
+
+    def free(self, blocks: list[int]) -> None:
+        self.allocator.free(blocks)
+
+    def table_row(self, blocks: list[int], max_blocks: int) -> list[int]:
+        """Fixed-width table row: allocated ids then null padding."""
+        if len(blocks) > max_blocks:
+            raise ValueError(
+                f"{len(blocks)} blocks exceed table width {max_blocks}")
+        return list(blocks) + [NULL_BLOCK] * (max_blocks - len(blocks))
+
+    def write_prefill(self, blocks: list[int], k: jax.Array,
+                      v: jax.Array) -> None:
+        """Copy a dense prefill cache slice into allocated blocks.
+
+        ``k``/``v``: [L, P, kvH, hd] (the batch-1 prefill cache row,
+        squeezed).  P is right-padded with zeros to a whole number of
+        blocks here; the pad cells are dead until the decode steps that
+        overwrite them, and the mask excludes them meanwhile.
+        """
+        L, P, H, hd = k.shape
+        n = len(blocks)
+        pad = n * self.block_size - P
+        if pad < 0:
+            raise ValueError(
+                f"{P} prefill tokens need "
+                f"{blocks_for_tokens(P, self.block_size)} blocks, "
+                f"got {n}")
+        idx = jnp.asarray(blocks, jnp.int32)
+        for side, dense in (("k", k), ("v", v)):
+            x = jnp.pad(dense, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            view = x.reshape(L, n, self.block_size, H, hd)
+            leaf = self.kv[side]
+            if self.quantize:
+                q = quantize_kv(view)
+                self.kv[side] = {
+                    "q": leaf["q"].at[:, idx].set(q["q"]),
+                    "scale": leaf["scale"].at[:, idx].set(q["scale"]),
+                }
+            else:
+                self.kv[side] = leaf.at[:, idx].set(
+                    view.astype(leaf.dtype))

@@ -1,0 +1,240 @@
+"""Continuous-batching scheduler: iteration-level admission/eviction.
+
+The unit of scheduling is the *decode step*, not the batch: between any
+two steps the scheduler may evict finished sequences (freeing their KV
+blocks) and admit queued requests into the vacated slots — new work
+joins a running batch without draining it.  This is the vLLM-style
+discipline the serving literature shows decides TPU serving economics
+(PAPERS.md, arxiv 2605.25645): decode slots stay occupied instead of
+waiting for the longest request of a static batch.
+
+Admission is gated by a **static KV fit check** — a request enters a
+slot only if the pool can cover its blocks under the chosen policy:
+
+- ``"reserve"`` (default): allocate the WORST-CASE blocks up front
+  (prompt + max_new_tokens).  A running request can never hit an
+  allocation failure mid-decode, so there is no preemption; admission
+  is simply blocked until enough blocks free up.  Predictable, and the
+  right default when parity/testing matters.
+- ``"optimistic"``: allocate only the prompt's blocks at admission and
+  grow one block at a time as decode crosses block boundaries.  Higher
+  occupancy (no reservation for tokens that may never be generated —
+  most requests stop at EOS early), at the price of mid-decode
+  allocation failures resolved by **preempting the youngest slot**:
+  its blocks are freed and the request is re-queued at the FRONT to
+  be recomputed from scratch later (recompute-style preemption — no
+  cache swap to host).  ``Request.preempted`` counts the restarts.
+
+The scheduler owns no device state: it moves ``Request`` objects
+between queue and slots and block ids between the allocator and block
+tables.  The engine asks it what changed and mirrors that into the
+slot-padded device arrays.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from collections import deque
+from typing import Any
+
+from .kv_pool import BlockAllocator, blocks_for_tokens
+
+_rid_counter = itertools.count()
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request and its lifecycle bookkeeping."""
+
+    prompt: list[int]
+    max_new_tokens: int
+    rid: int = dataclasses.field(
+        default_factory=lambda: next(_rid_counter))
+    eos_id: int | None = None
+
+    # lifecycle: queued -> running -> done (preemption loops back)
+    state: str = "queued"
+    slot: int | None = None
+    blocks: list[int] = dataclasses.field(default_factory=list)
+    out_tokens: list[int] = dataclasses.field(default_factory=list)
+    preempted: int = 0
+
+    # wall-clock marks for the serve.request span fields
+    t_submit: float = dataclasses.field(default_factory=time.monotonic)
+    t_admit: float | None = None
+    t_first_token: float | None = None
+    t_done: float | None = None
+
+    @property
+    def n_prompt(self) -> int:
+        return len(self.prompt)
+
+    @property
+    def n_generated(self) -> int:
+        return len(self.out_tokens)
+
+    @property
+    def max_tokens_total(self) -> int:
+        return self.n_prompt + self.max_new_tokens
+
+    def finished(self) -> bool:
+        if self.n_generated >= self.max_new_tokens:
+            return True
+        return (self.eos_id is not None and self.out_tokens
+                and self.out_tokens[-1] == self.eos_id)
+
+
+class Scheduler:
+    """Queue + slots + block accounting (host-side, no device state)."""
+
+    def __init__(self, *, n_slots: int, allocator: BlockAllocator,
+                 block_size: int, admission: str = "reserve"):
+        if admission not in ("reserve", "optimistic"):
+            raise ValueError(f"unknown admission policy {admission!r}")
+        self.n_slots = n_slots
+        self.allocator = allocator
+        self.block_size = block_size
+        self.admission = admission
+        self.queue: deque[Request] = deque()
+        self.slots: list[Request | None] = [None] * n_slots
+        self.n_finished = 0
+        self.n_preemptions = 0
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def n_active(self) -> int:
+        return sum(r is not None for r in self.slots)
+
+    @property
+    def n_queued(self) -> int:
+        return len(self.queue)
+
+    def idle(self) -> bool:
+        return self.n_active == 0 and not self.queue
+
+    def check_invariants(self) -> None:
+        """Structural invariants; raises AssertionError on violation.
+
+        Cheap enough to run every test step: no block on two live
+        tables, no live request holding the null block, allocator live
+        set == union of slot tables, free+live == num_blocks-1.
+        """
+        seen: set[int] = set()
+        for r in self.slots:
+            if r is None:
+                continue
+            for b in r.blocks:
+                assert b != 0, f"request {r.rid} holds the null block"
+                assert b not in seen, f"block {b} on two live tables"
+                seen.add(b)
+        assert seen == self.allocator._live, (
+            f"allocator live set {sorted(self.allocator._live)} != "
+            f"slot tables {sorted(seen)}")
+        assert (self.allocator.n_free + len(seen)
+                == self.allocator.num_blocks - 1), "block leak"
+        for r in self.queue:
+            assert not r.blocks, (
+                f"queued request {r.rid} still holds blocks")
+
+    # -- admission / eviction ------------------------------------------------
+
+    def submit(self, req: Request) -> None:
+        req.state = "queued"
+        self.queue.append(req)
+
+    def _blocks_at_admission(self, req: Request) -> int:
+        if self.admission == "reserve":
+            return blocks_for_tokens(req.max_tokens_total,
+                                     self.block_size)
+        return blocks_for_tokens(req.n_prompt, self.block_size)
+
+    def admit(self) -> list[tuple[int, Request]]:
+        """Move queued requests into free slots (FIFO) while the fit
+        check passes; returns the (slot, request) pairs admitted this
+        step — the engine prefills exactly these."""
+        admitted: list[tuple[int, Request]] = []
+        for slot in range(self.n_slots):
+            if self.slots[slot] is not None or not self.queue:
+                continue
+            req = self.queue[0]
+            got = self.allocator.alloc(self._blocks_at_admission(req))
+            if got is None:
+                break  # FIFO: later (possibly smaller) requests wait
+            self.queue.popleft()
+            req.blocks = got
+            req.slot = slot
+            req.state = "running"
+            req.out_tokens = []
+            req.t_admit = time.monotonic()
+            self.slots[slot] = req
+            admitted.append((slot, req))
+        return admitted
+
+    def evict(self, slot: int) -> Request:
+        """Finished request out of its slot; blocks back to the pool."""
+        req = self.slots[slot]
+        assert req is not None, f"evict of empty slot {slot}"
+        self.allocator.free(req.blocks)
+        req.blocks = []
+        req.slot = None
+        req.state = "done"
+        req.t_done = time.monotonic()
+        self.slots[slot] = None
+        self.n_finished += 1
+        return req
+
+    def preempt_youngest(self) -> Request | None:
+        """Free the most-recently-admitted slot's blocks and requeue it
+        at the FRONT (it regenerates from scratch — recompute-style).
+        Returns the victim, or None when no slot is occupied."""
+        victims = [r for r in self.slots if r is not None]
+        if not victims:
+            return None
+        victim = max(victims, key=lambda r: r.t_admit or 0.0)
+        slot = victim.slot
+        self.allocator.free(victim.blocks)
+        victim.blocks = []
+        victim.slot = None
+        victim.state = "queued"
+        victim.out_tokens = []
+        victim.preempted += 1
+        self.n_preemptions += 1
+        self.slots[slot] = None
+        self.queue.appendleft(victim)
+        return victim
+
+    def grow_for_step(self) -> list[Any]:
+        """Optimistic mode: before a decode step, every running request
+        about to write token ``ctx`` must own block ``ctx // bs``.
+        Grows tables one block at a time; on allocation failure,
+        preempts the youngest slot and retries (the shrunk batch frees
+        blocks).  Returns the requests that were preempted."""
+        preempted: list[Request] = []
+        if self.admission != "optimistic":
+            return preempted
+        for slot in range(self.n_slots):
+            while True:
+                req = self.slots[slot]
+                if req is None:
+                    break
+                # this step writes KV at absolute position
+                # n_prompt + n_generated - 1 (the first generated token
+                # is produced by prefill, before any paged write)
+                pos = req.n_prompt + req.n_generated - 1
+                if pos // self.block_size < len(req.blocks):
+                    break  # token fits in owned blocks
+                got = self.allocator.alloc(1)
+                if got is not None:
+                    req.blocks.extend(got)
+                    break
+                victim = self.preempt_youngest()
+                if victim is None:
+                    raise RuntimeError(
+                        "cannot grow KV blocks with no slot to preempt")
+                preempted.append(victim)
+                # if we preempted OURSELVES the slot is now empty and
+                # the outer loop moves on
+        return preempted

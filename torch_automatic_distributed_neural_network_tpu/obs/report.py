@@ -248,6 +248,41 @@ def generate(target: str, metrics_path: str | None = None) -> dict:
         gave_up = [e for e in restarts if e.get("gave_up")]
         if restarts:
             report["incidents"]["restarts_gave_up"] = len(gave_up)
+    sreqs = [e for e in events if e.get("name") == "serve.request"]
+    ssteps = [e for e in events if e.get("name") == "serve.step"]
+    spreempt = [e for e in events if e.get("name") == "serve.preempt"]
+    if sreqs or ssteps:
+        totals = sorted(_finite(e.get("total_s") for e in sreqs))
+
+        def pct(vals, q):
+            if not vals:
+                return None
+            return vals[min(len(vals) - 1,
+                            max(0, math.ceil(q * len(vals)) - 1))]
+
+        new_tokens = sum(_finite(e.get("n_new") for e in sreqs))
+        ts = _finite([e.get("t") for e in sreqs + ssteps])
+        wall = (max(ts) - min(ts)) if len(ts) > 1 else None
+        serving: dict[str, Any] = {
+            "n_requests": len(sreqs),
+            "n_steps": len(ssteps),
+            "p50_latency_s": pct(totals, 0.50),
+            "p99_latency_s": pct(totals, 0.99),
+            "mean_queue_s": _mean(e.get("queue_s") for e in sreqs),
+            "mean_tokens_per_s": _mean(e.get("tokens_per_s")
+                                       for e in sreqs),
+            "total_new_tokens": new_tokens,
+            # aggregate goodput: generated tokens over the serving
+            # window — the number batching discipline moves
+            "goodput_tokens_per_s": (new_tokens / wall
+                                     if wall else None),
+            "mean_occupancy": _mean(e.get("occupancy") for e in ssteps),
+            "preemptions": (len(spreempt)
+                            or sum(int(e.get("preempted") or 0)
+                                   for e in sreqs)),
+        }
+        report["serving"] = {k: v for k, v in serving.items()
+                             if v is not None}
     lint_findings = [e for e in events if e.get("name") == "lint.finding"]
     lint_summary = last("lint.summary")
     lint_skipped = last("lint.skipped")
@@ -282,6 +317,15 @@ def generate(target: str, metrics_path: str | None = None) -> dict:
         if compiled.get("per_device_peak_bytes"):
             me["compiled_peak_bytes"] = compiled["per_device_peak_bytes"]
         report["memory_estimate"] = me
+    sest = last("lint.serve_estimate")
+    if sest:
+        report["serve_estimate"] = {
+            k: sest.get(k)
+            for k in ("max_streams", "requested_streams", "num_blocks",
+                      "blocks_per_stream", "block_size", "max_len",
+                      "quant_kv", "budget_bytes",
+                      "block_bytes_per_device")
+            if sest.get(k) is not None}
     if metrics_path and os.path.isfile(metrics_path):
         recs = _read_metrics(metrics_path)
         steps = [r for r in recs if "step_time_s" in r]
@@ -467,6 +511,36 @@ def format_report(report: dict) -> str:
                     f"  rollback ({d.get('reason')}): step "
                     f"{d.get('at_step')} -> {d.get('to_step')}, skipped "
                     f"{d.get('skipped_batches')} batch(es)")
+    sv = report.get("serving")
+    if sv:
+        head = f"serving: {sv.get('n_requests', 0)} request(s)"
+        if sv.get("p50_latency_s") is not None:
+            head += (f", latency p50 {sv['p50_latency_s'] * 1e3:.0f}ms"
+                     f" p99 {sv.get('p99_latency_s', 0) * 1e3:.0f}ms")
+        if sv.get("goodput_tokens_per_s") is not None:
+            head += f", goodput {sv['goodput_tokens_per_s']:.1f} tok/s"
+        lines.append(head)
+        parts = []
+        if sv.get("mean_occupancy") is not None:
+            parts.append(f"slot occupancy {sv['mean_occupancy']:.1%} "
+                         f"over {sv.get('n_steps', 0)} step(s)")
+        if sv.get("mean_queue_s") is not None:
+            parts.append(f"mean queue {sv['mean_queue_s'] * 1e3:.0f}ms")
+        if sv.get("mean_tokens_per_s") is not None:
+            parts.append(
+                f"per-request {sv['mean_tokens_per_s']:.1f} tok/s")
+        parts.append(f"{sv.get('preemptions', 0)} preemption(s)")
+        lines.append("  " + "  ".join(parts))
+    sest = report.get("serve_estimate")
+    if sest:
+        head = (f"serve estimate: {sest.get('max_streams')} stream(s) "
+                f"of {sest.get('max_len')} tokens "
+                f"({sest.get('num_blocks')} blocks x "
+                f"bs {sest.get('block_size')}"
+                f"{', int8 KV' if sest.get('quant_kv') else ''})")
+        if sest.get("requested_streams") is not None:
+            head += f", requested {sest['requested_streams']}"
+        lines.append(head)
     lint = report.get("lint")
     if lint:
         head = (f"lint ({lint.get('phase', 'check')}): "
@@ -550,18 +624,53 @@ def check_bench(target: str, *, bench_path: str | None = None,
     ``target`` is a directory holding ``BENCH_r*.json`` +
     ``BENCH_LAST_GOOD.json`` (the repo root in CI); explicit paths
     override discovery.  Returns ``(exit_code, messages)``.
+
+    The serving trajectory (``SERVE_BENCH_r*.json`` +
+    ``SERVE_LAST_GOOD.json`` from bench_serve.py) is checked under the
+    SAME rules whenever either artifact exists in ``target`` — once a
+    serving round has been committed it can never silently go stale —
+    and skipped entirely before that (a training-only checkout is not
+    failed for a trajectory it never started).  Explicit ``bench_path``
+    / ``last_good_path`` bypass the serve check (single-family mode).
     """
     import glob as _glob
 
     d = target if os.path.isdir(target) else os.path.dirname(
         os.path.abspath(target)) or "."
+    if bench_path is None and last_good_path is None:
+        code, msgs = _check_bench_family(
+            d, "BENCH", bench_path=None, last_good_path=None)
+        armed = (_glob.glob(os.path.join(d, "SERVE_BENCH_r*.json"))
+                 or os.path.isfile(
+                     os.path.join(d, "SERVE_LAST_GOOD.json")))
+        if armed:
+            scode, smsgs = _check_bench_family(
+                d, "SERVE_BENCH", bench_path=None, last_good_path=None)
+            code = max(code, scode)
+            msgs = msgs + smsgs
+        return code, msgs
+    return _check_bench_family(d, "BENCH", bench_path=bench_path,
+                               last_good_path=last_good_path)
+
+
+def _check_bench_family(d: str, prefix: str, *,
+                        bench_path: str | None,
+                        last_good_path: str | None
+                        ) -> tuple[int, list[str]]:
+    """One trajectory's freshness check (``{prefix}_r*.json`` vs the
+    family's LAST_GOOD)."""
+    import glob as _glob
+
+    lg_name = ("BENCH_LAST_GOOD.json" if prefix == "BENCH"
+               else prefix.replace("_BENCH", "") + "_LAST_GOOD.json")
     msgs: list[str] = []
     if bench_path is None:
-        rounds = sorted(_glob.glob(os.path.join(d, "BENCH_r*.json")))
+        rounds = sorted(_glob.glob(os.path.join(d, f"{prefix}_r*.json")))
         bench_path = rounds[-1] if rounds else None
     if bench_path is None or not os.path.isfile(bench_path):
-        return 1, ["no bench record (BENCH_r*.json) found — the bench "
-                   "trajectory is dark"]
+        return 1, [f"no bench record ({prefix}_r*.json) found — the "
+                   + ("serving" if prefix != "BENCH" else "bench")
+                   + " trajectory is dark"]
     rec = _load_bench_record(bench_path)
     if rec is None:
         return 1, [f"{bench_path}: unreadable bench record"]
@@ -576,7 +685,7 @@ def check_bench(target: str, *, bench_path: str | None = None,
     elif "unmeasurable" in metric:
         msgs.append(f"{name}: unmeasurable ({metric})")
     else:
-        lg_path = last_good_path or os.path.join(d, "BENCH_LAST_GOOD.json")
+        lg_path = last_good_path or os.path.join(d, lg_name)
         try:
             with open(lg_path) as f:
                 last_good = json.load(f)
