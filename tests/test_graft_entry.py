@@ -116,19 +116,32 @@ def test_dryrun_backend_unreachable_degrades_to_smoke(monkeypatch, capsys):
         __graft_entry__, "_reexec_cpu_sim",
         lambda n, smoke=False: calls.append((n, smoke)),
     )
+    monkeypatch.setattr(
+        __graft_entry__, "_launch_smoke",
+        lambda n: {"ok": True, "parity": True, "restarts_used": 1,
+                   "final_loss": 1.0, "world": 1, "rc": 0},
+    )
     __graft_entry__.dryrun_multichip(4)
     assert calls == [(4, True)]
     recs = [json.loads(ln) for ln in capsys.readouterr().out.splitlines()
             if ln.startswith("{")]
-    assert len(recs) == 1
+    assert len(recs) == 2
     rec = recs[0]
     assert rec["status"] == "backend_unreachable"
     assert rec["fallback"] == "cpu_sim_smoke"
     assert rec["n_devices"] == 4
     assert "error" in rec and rec["configs"]
+    # the trajectory also routes through the elastic launcher and says
+    # so explicitly — the record is simulated, never silent
+    sim = recs[1]
+    assert sim["status"] == "simulated"
+    assert sim["launch"]["ok"] and sim["launch"]["parity"]
+    assert "backend_error" in sim
 
 
-def test_dryrun_healthy_backend_keeps_full_matrix(monkeypatch):
+def test_dryrun_healthy_backend_keeps_full_matrix(monkeypatch, capsys):
+    import json
+
     calls = []
     monkeypatch.delenv(__graft_entry__._CHILD_FLAG, raising=False)
     monkeypatch.setattr(__graft_entry__, "_probe_backend",
@@ -137,8 +150,19 @@ def test_dryrun_healthy_backend_keeps_full_matrix(monkeypatch):
         __graft_entry__, "_reexec_cpu_sim",
         lambda n, smoke=False: calls.append((n, smoke)),
     )
+    monkeypatch.setattr(
+        __graft_entry__, "_launch_smoke",
+        lambda n: {"ok": True, "parity": True, "restarts_used": 0,
+                   "final_loss": 1.0, "world": 1, "rc": 0},
+    )
     __graft_entry__.dryrun_multichip(4)
     assert calls == [(4, False)]
+    recs = [json.loads(ln) for ln in capsys.readouterr().out.splitlines()
+            if ln.startswith("{")]
+    # healthy backend: no unreachable record, but the launch-smoke leg
+    # still reports (sim mesh -> status=simulated, no backend_error)
+    assert [r["status"] for r in recs] == ["simulated"]
+    assert "backend_error" not in recs[0]
 
 
 def test_dryrun_budget_exhausted_emits_record_and_exits_clean(

@@ -1,0 +1,296 @@
+"""Elastic multihost launcher tests (ISSUE 9 acceptance).
+
+Covers the four tentpole legs on the 8-device CPU sim:
+
+- async sharded checkpointing: per-host shards + global manifest,
+  barrier-free completion, same-plan bitwise round-trip;
+- resharding restore: dp/8 -> fsdp/4 -> dp+zero1/8 parameter AND
+  optimizer-state bitwise parity (checkpoints are plan-portable);
+- torn-shard fallback: a teared per-host shard quarantines the step
+  (``ckpt.corrupt``) and restore falls back one step, bitwise intact;
+- orchestrator chaos: ``Launcher`` with a seeded worker SIGKILL resumes
+  within the restart budget and reaches the clean run's losses bitwise.
+
+The launcher tests spawn real worker subprocesses (the same path
+``tadnn launch`` drives); the heavier 2-host logical-cohort variant is
+marked ``slow``.
+"""
+
+import json
+import os
+import time
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+import torch_automatic_distributed_neural_network_tpu as tad
+from torch_automatic_distributed_neural_network_tpu import cli, planner
+from torch_automatic_distributed_neural_network_tpu.data.synthetic import (
+    SyntheticClassification,
+)
+from torch_automatic_distributed_neural_network_tpu.models import MLP
+from torch_automatic_distributed_neural_network_tpu.obs import Journal
+from torch_automatic_distributed_neural_network_tpu.obs import (
+    journal as obs_journal,
+)
+from torch_automatic_distributed_neural_network_tpu.training import (
+    ChaosPlan,
+    ShardedCheckpoint,
+    launch_doctor,
+    resilience,
+    softmax_xent_loss,
+    tear_shard,
+)
+from torch_automatic_distributed_neural_network_tpu.training import (
+    elastic,
+    launch,
+    shards,
+)
+
+P = jax.sharding.PartitionSpec
+
+
+# -- planner re-slicing math --------------------------------------------------
+
+
+def test_leaf_shard_slices_tiles_exactly():
+    degrees = {"data": 2, "fsdp": 2, "tensor": 2}
+    slices = planner.leaf_shard_slices((8, 6), P("fsdp", "tensor"), degrees)
+    assert len(slices) == 4  # 2 x 2 unique shards, replicas collapsed
+    covered = np.zeros((8, 6), dtype=np.int32)
+    for sl in slices:
+        idx = tuple(slice(a, b) for a, b in sl)
+        covered[idx] += 1
+    np.testing.assert_array_equal(covered, np.ones((8, 6), np.int32))
+
+
+def test_leaf_shard_slices_indivisible_dim_unsharded():
+    # 10 % 4 != 0 -> the dim stays whole (planner divisibility rule)
+    slices = planner.leaf_shard_slices((10,), P(("data", "fsdp")),
+                                       {"data": 2, "fsdp": 2})
+    assert slices == [((0, 10),)]
+
+
+def test_leaf_owner_is_deterministic_total_partition():
+    paths = [f"params/layer{i}/kernel" for i in range(64)]
+    owners = {p: shards._leaf_owner(p, 4) for p in paths}
+    assert owners == {p: shards._leaf_owner(p, 4) for p in paths}
+    assert set(owners.values()) == {0, 1, 2, 3}  # every host owns some
+
+
+# -- heartbeat: cross-process liveness fields ---------------------------------
+
+
+def test_heartbeat_writes_pid_and_monotonic(tmp_path):
+    hb = elastic.Heartbeat(str(tmp_path / "heartbeats"), interval_s=60.0,
+                           host_index=3)
+    hb.set_step(7)
+    hb._write()
+    beats = launch.read_heartbeats(str(tmp_path))
+    assert set(beats) == {3}
+    b = beats[3]
+    assert b["pid"] == os.getpid()
+    assert b["step"] == 7
+    assert 0 < b["mono"] <= time.monotonic()
+
+
+# -- sharded checkpoint: save / reshard / tear --------------------------------
+
+
+def _make_ad(strategy, *, devices=None, zero1=False):
+    return tad.AutoDistribute(
+        MLP(features=(64, 32, 10)),
+        optimizer=optax.adam(1e-2),  # adam: non-trivial opt state (mu/nu)
+        loss_fn=softmax_xent_loss,
+        strategy=strategy,
+        zero1=zero1,
+        devices=devices,
+    )
+
+
+def _data():
+    return SyntheticClassification(image_shape=(64,), num_classes=10,
+                                   batch_size=16)
+
+
+def _run_steps(ad, n=2):
+    data = _data()
+    state = ad.init(jax.random.key(0), data.batch(0))
+    for i in range(n):
+        state, _ = ad.step(state, data.batch(i))
+    jax.block_until_ready(state.params)
+    return state
+
+
+def _abstract(state):
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                       sharding=x.sharding), state)
+
+
+def _leaves(state):
+    out = []
+    for x in jax.tree.leaves(state):
+        if hasattr(x, "dtype") and jax.dtypes.issubdtype(
+                x.dtype, jax.dtypes.prng_key):
+            x = jax.random.key_data(x)
+        out.append(np.asarray(x))
+    return out
+
+
+def test_sharded_roundtrip_same_plan_bitwise(devices8, tmp_path):
+    state = _run_steps(_make_ad("dp"))
+    with ShardedCheckpoint(str(tmp_path / "ck")) as ck:
+        ck.save(2, state)
+        ck.wait()
+        assert ck.latest_step() == 2
+        restored = ck.restore(_abstract(state))
+    for a, b in zip(_leaves(state), _leaves(restored)):
+        np.testing.assert_array_equal(a, b)
+    report = shards.verify_directory(str(tmp_path / "ck"))
+    assert report["healthy"] and report["best_step"] == 2
+
+
+def test_reshard_dp8_fsdp4_dp_zero1_8_bitwise(devices8, tmp_path):
+    """The satellite round trip: a checkpoint written under dp/8 restores
+    under fsdp/4 (different mesh AND world), then back under dp+zero1/8,
+    with params and optimizer state bitwise intact at every hop."""
+    state8 = _run_steps(_make_ad("dp"))
+
+    d1, d2 = str(tmp_path / "hop1"), str(tmp_path / "hop2")
+    with ShardedCheckpoint(d1) as ck:
+        ck.save(2, state8)
+        ck.wait()
+
+    ad4 = _make_ad("fsdp", devices=jax.devices()[:4])
+    state4 = _run_steps(ad4, n=1)  # target shardings only; values replaced
+    with ShardedCheckpoint(d1) as ck:
+        state4 = ck.restore(_abstract(state4))
+    for a, b in zip(_leaves(state8), _leaves(state4)):
+        np.testing.assert_array_equal(a, b)
+
+    with ShardedCheckpoint(d2) as ck:
+        ck.save(2, state4)
+        ck.wait()
+
+    adz = _make_ad("dp", zero1=True)
+    statez = _run_steps(adz, n=1)
+    with ShardedCheckpoint(d2) as ck:
+        statez = ck.restore(_abstract(statez))
+    for a, b in zip(_leaves(state8), _leaves(statez)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_torn_shard_falls_back_one_step_and_journals(devices8, tmp_path):
+    ad = _make_ad("dp")
+    data = _data()
+    state = ad.init(jax.random.key(0), data.batch(0))
+    j = Journal()
+    with obs_journal.as_default(j):
+        with ShardedCheckpoint(str(tmp_path / "ck")) as ck:
+            for i in range(4):
+                state, _ = ad.step(state, data.batch(i))
+                if (i + 1) % 2 == 0:
+                    ck.save(i + 1, state)
+                    if i + 1 == 2:
+                        ck.wait()
+                        kept = _leaves(state)
+            ck.wait()
+            assert ck.all_steps() == [2, 4]
+            assert tear_shard(str(tmp_path / "ck"), 4)
+            with pytest.raises(resilience.CheckpointCorruptError):
+                ck.restore(_abstract(state), step=4)
+            # the trainer's fallback walk: quarantine, retry at latest
+            ck.quarantine(4, reason="torn shard")
+            assert ck.latest_step() == 2
+            restored = ck.restore(_abstract(state))
+    corrupt = [r for r in j.records if r.get("name") == "ckpt.corrupt"]
+    assert corrupt and corrupt[0]["step"] == 4
+    assert os.path.isdir(str(tmp_path / "ck" / "4.corrupt"))
+    for a, b in zip(kept, _leaves(restored)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_async_save_journals_queue_metrics(devices8, tmp_path):
+    state = _run_steps(_make_ad("dp"), n=1)
+    j = Journal()
+    with obs_journal.as_default(j):
+        with ShardedCheckpoint(str(tmp_path / "ck")) as ck:
+            ck.save(1, state)
+            ck.wait()
+    saves = [r for r in j.records if r.get("name") == "ckpt.async_save"]
+    assert saves
+    assert saves[0]["queue_depth"] >= 0
+    assert saves[0]["off_thread_s"] >= 0.0
+
+
+# -- the launcher: SIGKILL chaos, resume, bitwise parity ----------------------
+
+
+def _launch_cfg(launch_dir, **kw):
+    base = dict(launch_dir=str(launch_dir), hosts=1, local_devices=4,
+                steps=4, ckpt_every=2, seed=0, max_restarts=2,
+                heartbeat_interval_s=0.25)
+    base.update(kw)
+    return launch.LaunchConfig(**base)
+
+
+def test_launcher_sigkill_resumes_to_bitwise_parity(tmp_path):
+    clean = launch.Launcher(_launch_cfg(tmp_path / "clean")).run()
+    assert clean["ok"], clean
+    assert clean["restarts_used"] == 0
+    assert clean["final_step"] == 4
+
+    chaos = launch.Launcher(_launch_cfg(
+        tmp_path / "chaos",
+        chaos=ChaosPlan(seed=0, sigkill_at=(3,), chaos_host=0),
+    )).run()
+    assert chaos["ok"], chaos
+    assert chaos["restarts_used"] >= 1
+    # seeded chaos acceptance: resumed trajectory is bitwise identical
+    assert clean["losses"] == chaos["losses"]
+    assert clean["losses"]  # non-vacuous: per-step losses were recorded
+
+    doc = launch_doctor(str(tmp_path / "chaos"))
+    assert doc["ok"] is True
+    assert doc["restarts_used"] >= 1
+    assert doc["last_failure"]["host"] == 0
+    assert doc["complete_ckpt_steps"]
+    assert cli.main(["doctor", "--launch-dir", str(tmp_path / "chaos")]) == 0
+
+    merged = chaos["merged_journal"]
+    assert merged and os.path.exists(merged)
+    kills = [r for r in Journal.read(merged)
+             if r.get("name") == "launch.chaos"]
+    assert kills and kills[0]["kind"] == "sigkill"
+
+
+@pytest.mark.slow
+def test_launcher_two_logical_hosts_elastic_kill(tmp_path):
+    """2 logical hosts on the CPU sim: kill host 1 mid-run; the cohort
+    restarts and completes, per-host shard files from both hosts land in
+    the checkpoint, and the trajectory matches a clean run bitwise."""
+    clean = launch.Launcher(_launch_cfg(
+        tmp_path / "clean", hosts=2, local_devices=4)).run()
+    assert clean["ok"], clean
+
+    chaos = launch.Launcher(_launch_cfg(
+        tmp_path / "chaos", hosts=2, local_devices=4,
+        chaos=ChaosPlan(seed=0, sigkill_at=(3,), chaos_host=1),
+    )).run()
+    assert chaos["ok"], chaos
+    assert chaos["restarts_used"] >= 1
+    assert clean["losses"] == chaos["losses"]
+
+    step_d = shards.step_dir(
+        os.path.join(str(tmp_path / "chaos"), launch.CKPT_DIRNAME), 4)
+    names = set(os.listdir(step_d))
+    assert {"host-0.json", "host-0.npz", "host-1.json",
+            "host-1.npz", "meta.json"} <= names
+
+    with open(os.path.join(str(tmp_path / "chaos"),
+                           launch.STATE_FILE)) as f:
+        st = json.load(f)
+    assert st["ok"] and st["restarts_used"] >= 1

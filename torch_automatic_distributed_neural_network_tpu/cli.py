@@ -464,15 +464,121 @@ def cmd_report(args: argparse.Namespace) -> int:
 def cmd_doctor(args: argparse.Namespace) -> int:
     """Verify a checkpoint directory's integrity and print the fallback
     chain restore_or_init would walk.  Exit 0 when at least one step is
-    restorable, 1 otherwise (corrupt-only or empty directory)."""
+    restorable, 1 otherwise (corrupt-only or empty directory).
+
+    ``--launch-dir`` switches to launch supervision health (training/
+    launch.py): per-host last-seen heartbeats, restart-budget
+    consumption, and which host broke the cohort."""
     from .training import resilience
 
-    report = resilience.verify_directory(args.directory)
+    if getattr(args, "launch_dir", None):
+        from .training import launch as launch_mod
+
+        doc = launch_mod.launch_doctor(args.launch_dir)
+        if args.json:
+            print(json.dumps(doc))
+        else:
+            print(launch_mod.format_launch_doctor(doc))
+        return 1 if doc.get("ok") is False else 0
+    if not args.directory:
+        print("doctor: a checkpoint directory or --launch-dir is required",
+              file=sys.stderr)
+        return 2
+    from .training import shards
+
+    # sharded-format dirs (training/shards.py) carry a meta.json per
+    # step; verify those through the per-host shard chain instead
+    sharded = any(
+        os.path.isfile(os.path.join(args.directory, str(s), "meta.json"))
+        for s in resilience.list_steps(args.directory)
+    )
+    report = (shards.verify_directory(args.directory) if sharded
+              else resilience.verify_directory(args.directory))
     if args.json:
         print(json.dumps(report))
     else:
         print(resilience.format_doctor(report))
     return 0 if report["healthy"] else 1
+
+
+def cmd_launch(args: argparse.Namespace) -> int:
+    """Elastic multihost launch (training/launch.py): spawn + supervise
+    N simulated-mesh workers with sharded async checkpoints, cohort
+    restart under the RestartPolicy budget, and seeded chaos.
+
+    ``--smoke`` runs the acceptance pair — a clean run and a chaos run
+    (one SIGKILL) — and exits nonzero unless the chaos run resumes to
+    **bitwise-identical** per-step losses."""
+    from .training import resilience
+    from .training.launch import LaunchConfig, Launcher
+
+    chaos = None
+    if args.kill_host_at or args.tear_shard_at or args.partition_journal_at:
+        chaos = resilience.ChaosPlan(
+            seed=args.seed,
+            sigkill_at=tuple(args.kill_host_at or ()),
+            shard_tear_at=tuple(args.tear_shard_at or ()),
+            journal_partition_at=tuple(args.partition_journal_at or ()),
+            chaos_host=args.chaos_host,
+        )
+
+    def make_cfg(launch_dir: str, chaos_plan) -> LaunchConfig:
+        return LaunchConfig(
+            launch_dir=launch_dir, hosts=args.hosts,
+            local_devices=args.local_devices, steps=args.steps,
+            ckpt_every=args.ckpt_every, strategy=args.strategy,
+            zero1=args.zero1, seed=args.seed,
+            max_restarts=args.max_restarts, elastic=args.elastic,
+            watchdog_s=args.watchdog_s, chaos=chaos_plan,
+            heartbeat_interval_s=args.heartbeat_interval_s,
+        )
+
+    if args.smoke:
+        # acceptance pair: uninterrupted oracle, then the same seeded
+        # run with one SIGKILL mid-step — per-step losses must match
+        # bitwise after the resume
+        if chaos is None:
+            chaos = resilience.ChaosPlan(
+                seed=args.seed, sigkill_at=(max(args.ckpt_every + 1, 3),),
+                chaos_host=args.chaos_host)
+        clean = Launcher(make_cfg(
+            os.path.join(args.launch_dir, "clean"), None)).run()
+        chaotic = Launcher(make_cfg(
+            os.path.join(args.launch_dir, "chaos"), chaos)).run()
+        parity = (clean.get("ok") and chaotic.get("ok")
+                  and clean.get("losses") == chaotic.get("losses"))
+        out = {
+            "ok": bool(parity),
+            "clean_ok": clean.get("ok"),
+            "chaos_ok": chaotic.get("ok"),
+            "parity": bool(clean.get("losses")
+                           and clean.get("losses") == chaotic.get("losses")),
+            "restarts_used": chaotic.get("restarts_used"),
+            "final_loss": chaotic.get("final_loss"),
+            "world": chaotic.get("world"),
+            "merged_journal": chaotic.get("merged_journal"),
+            "launch_dir": args.launch_dir,
+        }
+        if not chaotic.get("ok"):
+            out["error"] = chaotic.get("error")
+        print(json.dumps(out))
+        return 0 if out["ok"] else 1
+
+    result = Launcher(make_cfg(args.launch_dir, chaos)).run()
+    if args.json:
+        print(json.dumps(result))
+    else:
+        if result["ok"]:
+            print(f"launch ok: world={result['world']} "
+                  f"rounds={result['rounds']} "
+                  f"restarts={result['restarts_used']} "
+                  f"final_step={result['final_step']} "
+                  f"final_loss={result['final_loss']}")
+            if result.get("merged_journal"):
+                print(f"merged journal: {result['merged_journal']}")
+        else:
+            print(f"launch FAILED: {result.get('error')}", file=sys.stderr)
+    return 0 if result["ok"] else 1
 
 
 def _fmt_mem_bytes(n) -> str:
@@ -951,9 +1057,55 @@ def main(argv: list[str] | None = None) -> int:
              "manifests, resilience.py) and print the fallback chain; "
              "exits nonzero when no step is restorable",
     )
-    p.add_argument("directory", help="CheckpointManager directory")
+    p.add_argument("directory", nargs="?", default=None,
+                   help="CheckpointManager or sharded-checkpoint directory")
+    p.add_argument("--launch-dir", default=None,
+                   help="report launch supervision health instead "
+                        "(per-host heartbeats, restart budget, which "
+                        "host broke the cohort)")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_doctor)
+
+    p = sub.add_parser(
+        "launch",
+        help="elastic multihost launcher: spawn + supervise N "
+             "simulated-mesh workers with async sharded checkpoints, "
+             "cohort restart on death/hang, and seeded chaos "
+             "(training/launch.py); --smoke runs the kill-and-resume "
+             "bitwise-parity acceptance pair",
+    )
+    p.add_argument("--launch-dir", required=True,
+                   help="run directory (heartbeats, shards, journals)")
+    p.add_argument("--hosts", type=int, default=2)
+    p.add_argument("--local-devices", type=int, default=4)
+    p.add_argument("--steps", type=int, default=6)
+    p.add_argument("--ckpt-every", type=int, default=2)
+    p.add_argument("--strategy", default="auto",
+                   help="worker strategy ('auto' re-plans per cohort)")
+    p.add_argument("--zero1", action="store_true")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--max-restarts", type=int, default=2)
+    p.add_argument("--elastic", action="store_true",
+                   help="shrink the cohort after a host death instead "
+                        "of respawning at full size")
+    p.add_argument("--watchdog-s", type=float, default=120.0,
+                   help="no heartbeat step-progress within this = hung")
+    p.add_argument("--heartbeat-interval-s", type=float, default=0.5)
+    p.add_argument("--kill-host-at", type=int, action="append",
+                   help="SIGKILL the chaos host when its heartbeat "
+                        "reaches this step (repeatable)")
+    p.add_argument("--tear-shard-at", type=int, action="append",
+                   help="tear the chaos host's shard of the newest "
+                        "committed step at this step (repeatable)")
+    p.add_argument("--partition-journal-at", type=int, action="append",
+                   help="partition the chaos host's journal at this "
+                        "step (repeatable)")
+    p.add_argument("--chaos-host", type=int, default=0)
+    p.add_argument("--smoke", action="store_true",
+                   help="clean + one-SIGKILL chaos pair; exit nonzero "
+                        "unless resumed losses match bitwise")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_launch)
 
     p = sub.add_parser(
         "check",

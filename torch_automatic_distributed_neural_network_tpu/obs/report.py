@@ -248,6 +248,41 @@ def generate(target: str, metrics_path: str | None = None) -> dict:
         gave_up = [e for e in restarts if e.get("gave_up")]
         if restarts:
             report["incidents"]["restarts_gave_up"] = len(gave_up)
+    rounds = [e for e in events if e.get("name") == "launch.round"]
+    lrestarts = [e for e in events if e.get("name") == "launch.restart"]
+    lchaos = [e for e in events if e.get("name") == "launch.chaos"]
+    replans = [e for e in events if e.get("name") == "launch.replan"]
+    async_saves = [e for e in events if e.get("name") == "ckpt.async_save"]
+    done = [e for e in events if e.get("name") == "launch.done"]
+    if rounds or lrestarts or done:
+        launch: dict = {
+            "rounds": len(rounds),
+            "restarts": len(lrestarts),
+            "chaos_faults": [{"kind": e.get("kind"), "step": e.get("step"),
+                              "host": e.get("host")} for e in lchaos],
+            "replans": [{"from": e.get("world_from"),
+                         "to": e.get("world_to")} for e in replans],
+            "worlds": [e.get("world") for e in rounds],
+            "completed": bool(done),
+        }
+        if lrestarts:
+            launch["broken_by"] = [
+                {"host": e.get("host"), "step": e.get("step"),
+                 "reason": e.get("reason")} for e in lrestarts]
+            launch["gave_up"] = any(e.get("gave_up") for e in lrestarts)
+        if done:
+            launch["final_step"] = done[-1].get("final_step")
+            launch["final_loss"] = done[-1].get("final_loss")
+        if async_saves:
+            durs = _finite(e.get("off_thread_s") for e in async_saves)
+            launch["async_saves"] = {
+                "n": len(async_saves),
+                "max_queue_depth": max((e.get("queue_depth") or 0)
+                                       for e in async_saves),
+                "mean_off_thread_s": (sum(durs) / len(durs)
+                                      if durs else None),
+            }
+        report["launch"] = launch
     sreqs = [e for e in events if e.get("name") == "serve.request"]
     ssteps = [e for e in events if e.get("name") == "serve.step"]
     spreempt = [e for e in events if e.get("name") == "serve.preempt"]
@@ -511,6 +546,36 @@ def format_report(report: dict) -> str:
                     f"  rollback ({d.get('reason')}): step "
                     f"{d.get('at_step')} -> {d.get('to_step')}, skipped "
                     f"{d.get('skipped_batches')} batch(es)")
+    la = report.get("launch")
+    if la:
+        worlds = la.get("worlds") or []
+        head = (f"launch: {la.get('rounds', 0)} round(s), "
+                f"{la.get('restarts', 0)} cohort restart(s), worlds "
+                + (" -> ".join(str(w) for w in worlds) if worlds else "?"))
+        if la.get("completed"):
+            head += (f"; completed at step {la.get('final_step')}"
+                     + (f" loss {la['final_loss']:.6g}"
+                        if la.get("final_loss") is not None else ""))
+        elif la.get("gave_up"):
+            head += "; GAVE UP (restart budget)"
+        lines.append(head)
+        for f in la.get("chaos_faults", [])[-4:]:
+            lines.append(f"  chaos {f.get('kind')} -> host "
+                         f"{f.get('host')} at step {f.get('step')}")
+        for b in la.get("broken_by", [])[-3:]:
+            lines.append(f"  cohort broken by host {b.get('host')} at "
+                         f"step {b.get('step')}: {b.get('reason')}")
+        for r in la.get("replans", []):
+            lines.append(f"  replanned world {r.get('from')} -> "
+                         f"{r.get('to')} (choose_strategy at new size)")
+        asv = la.get("async_saves")
+        if asv:
+            mean = asv.get("mean_off_thread_s")
+            lines.append(
+                f"  async saves: {asv['n']}, max queue depth "
+                f"{asv['max_queue_depth']}"
+                + (f", mean off-thread {mean * 1e3:.1f}ms"
+                   if mean is not None else ""))
     sv = report.get("serving")
     if sv:
         head = f"serving: {sv.get('n_requests', 0)} request(s)"

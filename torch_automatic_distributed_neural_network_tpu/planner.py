@@ -567,6 +567,59 @@ def spec_axes(spec: P) -> set[str]:
 _spec_axes = spec_axes
 
 
+# ---------------------------------------------------------------------------
+# Reshard slicing (sharded-checkpoint support, training/shards.py)
+# ---------------------------------------------------------------------------
+
+
+def spec_to_json(spec: P) -> list:
+    """A PartitionSpec as a JSON-serializable list (axis name, list of
+    axis names, or None per dim) — the on-disk form a sharded-checkpoint
+    manifest records so a restore under a different mesh can re-derive
+    the writer's slicing."""
+    out: list = []
+    for entry in spec:
+        if isinstance(entry, (tuple, list)):
+            out.append(list(entry))
+        else:
+            out.append(entry)
+    return out
+
+
+def spec_from_json(dims: Sequence[Any]) -> P:
+    """Inverse of :func:`spec_to_json`."""
+    return P(*[tuple(d) if isinstance(d, list) else d for d in dims])
+
+
+def leaf_shard_slices(
+    shape: Sequence[int],
+    spec: P,
+    degrees: Mapping[str, int],
+) -> list[tuple[tuple[int, int], ...]]:
+    """The unique shard slices of one leaf under ``spec`` on a mesh with
+    the given axis ``degrees`` — pure index math, no devices.
+
+    Each element is a per-dim ``(start, stop)`` tuple; together they tile
+    the global shape exactly (replicas collapsed — this is the replica-0
+    set a sharded checkpoint writes and the coverage a restore verifies
+    against).  A dim whose sharding degree does not divide it is treated
+    as unsharded, matching the planner's divisibility rules.
+    """
+    per_dim: list[list[tuple[int, int]]] = []
+    for d, size in enumerate(shape):
+        axes = spec[d] if d < len(spec) else None
+        deg = _axis_size(axes, degrees) if axes else 1
+        if deg <= 1 or size % deg != 0:
+            per_dim.append([(0, int(size))])
+            continue
+        chunk = size // deg
+        per_dim.append([(i * chunk, (i + 1) * chunk) for i in range(deg)])
+    out: list[tuple[tuple[int, int], ...]] = [()]
+    for choices in per_dim:
+        out = [prefix + (c,) for prefix in out for c in choices]
+    return sorted(out)
+
+
 def expected_collective_bytes(
     plan: ShardPlan,
     abstract_params: Any,

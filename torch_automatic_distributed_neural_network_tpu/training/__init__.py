@@ -51,6 +51,13 @@ _LAZY = {
     "StallError": "resilience",
     "tear_checkpoint": "resilience",
     "verify_directory": "resilience",
+    "ShardedCheckpoint": "shards",
+    "tear_shard": "shards",
+    "verify_sharded_directory": "shards",
+    "LaunchConfig": "launch",
+    "Launcher": "launch",
+    "launch_doctor": "launch",
+    "format_launch_doctor": "launch",
 }
 
 __all__ = [

@@ -12,6 +12,9 @@ from __future__ import annotations
 
 import json
 import os
+import queue
+import threading
+import time
 from typing import Any
 
 from typing import TYPE_CHECKING
@@ -113,9 +116,15 @@ class CheckpointManager:
                 enable_async_checkpointing=True,
             ),
         )
+        self._final_q: "queue.Queue[tuple | None]" = queue.Queue()
+        self._final_thread: threading.Thread | None = None
+        self._final_error: BaseException | None = None
 
     def save(self, step: int, state: "TrainState", config: dict | None = None,
              force: bool = False) -> bool:
+        if self._final_error is not None:
+            e, self._final_error = self._final_error, None
+            raise e
         encoded = _encode_keys(state)
         args = {
             "state": ocp.args.StandardSave(encoded),
@@ -129,19 +138,85 @@ class CheckpointManager:
             # leaf hashing needs fully-addressable arrays: single-
             # controller-with-every-shard-visible only (the CPU sim and
             # single-host TPU runs); multi-host integrity would need a
-            # per-host shard manifest
+            # per-host shard manifest (training/shards.py has one)
             if saved and self.integrity and jax.process_count() == 1:
-                # checksums come from the in-memory values being saved,
-                # so the manifest is valid even while the async commit
-                # is still in flight
-                resilience.write_manifest(self.directory, step, encoded)
-                rec["manifest"] = True
-                self._gc_manifests()
+                # checksums are taken NOW, from the in-memory values
+                # being saved (the next step may donate these buffers);
+                # the manifest itself is written by the finalizer thread
+                # only after the step's files are durable on disk — a
+                # crash mid-commit then leaves no manifest, and the
+                # fallback chain skips the step instead of trusting it
+                leaves = resilience.leaf_checksums(encoded)
+                self._ensure_finalizer()
+                self._final_q.put((int(step), leaves, time.monotonic()))
+                rec["manifest_queued"] = True
         return saved
 
+    # -- async manifest finalizer -------------------------------------------
+
+    def _ensure_finalizer(self) -> None:
+        if self._final_thread is None or not self._final_thread.is_alive():
+            self._final_thread = threading.Thread(
+                target=self._finalize_loop, daemon=True,
+                name="tadnn-ckpt-finalizer")
+            self._final_thread.start()
+
+    def _finalize_loop(self) -> None:
+        while True:
+            job = self._final_q.get()
+            try:
+                if job is not None:
+                    self._finalize(*job)
+            except BaseException as e:  # surfaced by wait()/next save
+                self._final_error = e
+            finally:
+                self._final_q.task_done()
+            if job is None:
+                return
+
+    def _finalize(self, step: int, leaves: dict, submitted: float) -> None:
+        """Off-thread step finalization: wait for orbax's atomic publish
+        (tmp dir renamed to ``<step>``), fsync the step's files so they
+        survive power loss, THEN write the manifest — the manifest's
+        existence now implies the data beneath it is durable."""
+        t0 = time.monotonic()
+        d = os.path.join(self.directory, str(int(step)))
+        deadline = t0 + 600.0
+        while not os.path.isdir(d):
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"orbax commit of step {step} never published {d}")
+            time.sleep(0.05)
+        for dirpath, _, files in os.walk(d):
+            for name in files:
+                try:
+                    fd = os.open(os.path.join(dirpath, name), os.O_RDONLY)
+                except OSError:
+                    continue  # commit-temp file GC'd under us
+                try:
+                    os.fsync(fd)
+                finally:
+                    os.close(fd)
+            try:
+                fd = os.open(dirpath, os.O_RDONLY)
+                os.fsync(fd)
+                os.close(fd)
+            except OSError:
+                pass
+        resilience.write_manifest(self.directory, step, None, leaves=leaves)
+        obs_journal.event(
+            "ckpt.async_save", step=int(step),
+            queue_depth=self._final_q.qsize(),
+            off_thread_s=round(time.monotonic() - t0, 6),
+            dispatch_to_durable_s=round(time.monotonic() - submitted, 6),
+        )
+        self._gc_manifests()
+
     def _gc_manifests(self) -> None:
-        """Drop manifests for steps orbax's max_to_keep GC removed."""
-        kept = set(self._mngr.all_steps())
+        """Drop manifests for steps orbax's max_to_keep GC removed.
+        Runs on the finalizer thread, so it scans the filesystem rather
+        than touching the (not thread-safe) orbax manager."""
+        kept = set(resilience.list_steps(self.directory))
         import glob
 
         for path in glob.glob(os.path.join(self.directory, "manifest-*.json")):
@@ -171,6 +246,7 @@ class CheckpointManager:
         """Move a corrupt step out of the chain (resilience.py) and
         resync orbax's view of the directory."""
         self._mngr.wait_until_finished()  # never rename under a writer
+        self._final_q.join()  # nor under the manifest finalizer
         resilience.quarantine_step(self.directory, step, reason)
         self._mngr.reload()
 
@@ -239,9 +315,17 @@ class CheckpointManager:
     def wait(self) -> None:
         with obs_journal.span("ckpt.wait"):
             self._mngr.wait_until_finished()
+            self._final_q.join()
+        if self._final_error is not None:
+            e, self._final_error = self._final_error, None
+            raise e
 
     def close(self) -> None:
         self._mngr.wait_until_finished()
+        self._final_q.join()
+        if self._final_thread is not None and self._final_thread.is_alive():
+            self._final_q.put(None)
+            self._final_thread.join(timeout=10)
         self._mngr.close()
 
 

@@ -92,8 +92,14 @@ class Heartbeat:
     def _write(self) -> None:
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
+            # pid lets a cross-process supervisor (training.launch) match
+            # the beat to the worker it spawned (a stale file from a
+            # previous cohort has a dead/foreign pid); mono is this
+            # process's monotonic clock, immune to wall-clock jumps when
+            # comparing two beats from the SAME writer
             json.dump({"host": self.host_index, "step": self._step,
-                       "time": time.time()}, f)
+                       "time": time.time(), "pid": os.getpid(),
+                       "mono": time.monotonic()}, f)
         os.replace(tmp, self.path)
 
     def start(self) -> "Heartbeat":

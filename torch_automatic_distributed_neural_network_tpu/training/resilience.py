@@ -107,19 +107,25 @@ def manifest_path(directory: str, step: int) -> str:
 
 
 def write_manifest(directory: str, step: int, tree: Any,
-                   extra: dict | None = None) -> str:
-    """Atomically (tmp+rename) write the integrity manifest for ``step``."""
+                   extra: dict | None = None, *,
+                   leaves: dict | None = None) -> str:
+    """Atomically (tmp+fsync+rename) write the integrity manifest for
+    ``step``.  ``leaves`` short-circuits the checksum pass with values
+    computed earlier — the async-save finalizer hashes on the training
+    thread (while the arrays are still live) but writes here later."""
     path = manifest_path(directory, step)
     doc = {
         "version": MANIFEST_VERSION,
         "step": int(step),
         "written_at": time.time(),
-        "leaves": leaf_checksums(tree),
+        "leaves": leaf_checksums(tree) if leaves is None else leaves,
         **(extra or {}),
     }
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(doc, f)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
     return path
 
@@ -450,6 +456,17 @@ class ChaosPlan:
       anomaly-rollback path;
     - ``stall``: the step callback sleeps ``stall_s`` — the watchdog /
       escalation path.
+
+    Orchestrator-level kinds (fired by ``training.launch``, not by an
+    in-process callback — faults a worker cannot inject on itself):
+
+    - ``sigkill``: SIGKILL the ``chaos_host`` worker when its heartbeat
+      reaches the step (no drain, no atexit — the hard-preemption path);
+    - ``journal_partition``: the ``chaos_host`` journal file is renamed
+      aside mid-run, simulating a network-partitioned host whose events
+      go dark (the merge/report side must degrade, not crash);
+    - ``shard_tear``: one host's shard file of the newest committed
+      sharded checkpoint is truncated — the cross-host integrity path.
     """
 
     seed: int = 0
@@ -457,11 +474,18 @@ class ChaosPlan:
     torn_ckpt_at: tuple[int, ...] = ()
     nan_at: tuple[int, ...] = ()
     stall_at: tuple[int, ...] = ()
+    sigkill_at: tuple[int, ...] = ()
+    journal_partition_at: tuple[int, ...] = ()
+    shard_tear_at: tuple[int, ...] = ()
     p_exception: float = 0.0
     p_torn_ckpt: float = 0.0
     p_nan: float = 0.0
     p_stall: float = 0.0
+    p_sigkill: float = 0.0
+    p_journal_partition: float = 0.0
+    p_shard_tear: float = 0.0
     stall_s: float = 0.0
+    chaos_host: int = 0  # which host orchestrator faults target
 
     def fires(self, kind: str, step: int) -> bool:
         at = {
@@ -469,14 +493,22 @@ class ChaosPlan:
             "torn_ckpt": self.torn_ckpt_at,
             "nan": self.nan_at,
             "stall": self.stall_at,
+            "sigkill": self.sigkill_at,
+            "journal_partition": self.journal_partition_at,
+            "shard_tear": self.shard_tear_at,
         }[kind]
         p = {
             "exception": self.p_exception,
             "torn_ckpt": self.p_torn_ckpt,
             "nan": self.p_nan,
             "stall": self.p_stall,
+            "sigkill": self.p_sigkill,
+            "journal_partition": self.p_journal_partition,
+            "shard_tear": self.p_shard_tear,
         }[kind]
         return step in at or _fires(self.seed, kind, step, p)
+
+    ORCHESTRATOR_KINDS = ("sigkill", "journal_partition", "shard_tear")
 
 
 def tear_checkpoint(directory: str, step: int, *, seed: int = 0,

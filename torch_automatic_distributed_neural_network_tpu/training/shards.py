@@ -1,0 +1,602 @@
+"""Per-host sharded checkpointing with resharding restore (launch layer).
+
+Orbax (checkpoint.py) already reshards on restore, but its commit
+protocol is coordinated: every host participates in one logical save.
+The launch orchestrator needs the opposite discipline — **barrier-free**
+per-host saves — so a straggler or SIGKILLed host can never torn-write a
+step the others believe committed.  This module implements that format:
+
+- ``<dir>/<step>/meta.json``: written by host 0 at dispatch; records the
+  expected world size, mesh degrees, per-leaf shapes/dtypes/
+  PartitionSpecs (``planner.spec_to_json``), and the run config.
+- ``<dir>/<step>/host-<i>.npz``: host *i*'s replica-0 shards, written
+  off the training thread (async), fsynced and renamed into place.
+- ``<dir>/<step>/host-<i>.json``: host *i*'s completion marker — shard
+  index metadata plus the sha256 of the npz — written only after the
+  npz is durable.  **A step is committed iff meta.json and every
+  expected host marker exist**; no barrier runs at save time, the
+  completion predicate is evaluated at restore time instead.
+
+Restore is resharding-first: shards from every host are reassembled
+into full host arrays and re-sliced through the *target* plan's
+shardings (``jax.make_array_from_callback``), so a checkpoint written
+under dp/8 restores under fsdp/4 or dp+zero1/8 unchanged.  Integrity
+extends PR 3's chain: markers carry shard-file sha256s, coverage is
+verified against ``planner.leaf_shard_slices``, and a torn shard
+quarantines the step (``<step>.corrupt`` + ``ckpt.corrupt`` journal
+event) so ``restore_or_init`` falls back one save interval.
+
+:class:`ShardedCheckpoint` implements the CheckpointManager protocol
+(save/restore/latest_step/quarantine/wait/...), so the Trainer and
+``restore_or_init`` drive it unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import queue
+import threading
+import time
+from typing import Any
+
+import numpy as np
+
+from ..obs import journal as obs_journal
+from . import resilience
+
+SHARD_FORMAT_VERSION = 1
+
+_META = "meta.json"
+
+
+def _host_npz(i: int) -> str:
+    return f"host-{int(i)}.npz"
+
+
+def _host_marker(i: int) -> str:
+    return f"host-{int(i)}.json"
+
+
+def _fsync_write(path: str, data: bytes) -> None:
+    """Write ``data`` durably: tmp file, flush+fsync, rename into place,
+    fsync the directory so the rename itself is durable."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path))
+
+
+def _fsync_dir(directory: str) -> None:
+    try:
+        fd = os.open(directory, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """np.dtype from its string name, including the ml_dtypes extras
+    (bfloat16 et al.) jax registers."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _slices_to_index(slices: tuple, shape: tuple[int, ...]) -> list[list[int]]:
+    """A shard's ``.index`` (tuple of slice objects) as explicit
+    ``[start, stop]`` pairs — slice(None) resolved against the shape."""
+    out = []
+    for d, s in enumerate(slices):
+        start = 0 if s.start is None else int(s.start)
+        stop = shape[d] if s.stop is None else int(s.stop)
+        out.append([start, stop])
+    return out
+
+
+def _index_key(index: list[list[int]]) -> tuple[tuple[int, int], ...]:
+    return tuple((int(a), int(b)) for a, b in index)
+
+
+def _leaf_owner(path: str, world: int) -> int:
+    """Stable owner host of a leaf in logical-host mode — a pure hash of
+    the leaf path (no Python hash randomization), so every host and
+    every restart partitions the tree identically."""
+    h = hashlib.blake2b(path.encode(), digest_size=8).digest()
+    return int.from_bytes(h, "big") % max(int(world), 1)
+
+
+def step_dir(directory: str, step: int) -> str:
+    return os.path.join(directory, str(int(step)))
+
+
+def read_meta(directory: str, step: int) -> dict | None:
+    try:
+        with open(os.path.join(step_dir(directory, step), _META)) as f:
+            meta = json.load(f)
+        if not isinstance(meta.get("leaves"), dict):
+            return None
+        return meta
+    except (OSError, ValueError):
+        return None
+
+
+def is_complete(directory: str, step: int) -> bool:
+    """The barrier-free completion predicate: meta + every expected
+    host's marker + shard file present.  A host that died mid-write left
+    no marker, so the step simply never commits — nothing to roll back."""
+    meta = read_meta(directory, step)
+    if meta is None:
+        return False
+    d = step_dir(directory, step)
+    for i in range(int(meta.get("world", 0))):
+        if not (os.path.isfile(os.path.join(d, _host_marker(i)))
+                and os.path.isfile(os.path.join(d, _host_npz(i)))):
+            return False
+    return True
+
+
+def list_complete_steps(directory: str) -> list[int]:
+    return [s for s in resilience.list_steps(directory)
+            if is_complete(directory, s)]
+
+
+def _read_markers(directory: str, step: int, world: int) -> list[dict]:
+    d = step_dir(directory, step)
+    markers = []
+    for i in range(world):
+        with open(os.path.join(d, _host_marker(i))) as f:
+            markers.append(json.load(f))
+    return markers
+
+
+def verify_step(directory: str, step: int) -> list[str]:
+    """Problems (empty = verified) for one committed sharded step:
+    markers parse, shard-file sha256s match, and the recorded slices
+    tile every leaf exactly as the writer's plan says they should
+    (``planner.leaf_shard_slices`` — the reshard-slicing contract)."""
+    from .. import planner
+
+    meta = read_meta(directory, step)
+    if meta is None:
+        return ["missing or torn meta.json"]
+    problems: list[str] = []
+    d = step_dir(directory, step)
+    try:
+        markers = _read_markers(directory, step, int(meta["world"]))
+    except (OSError, ValueError, KeyError) as e:
+        return [f"missing/torn host marker: {type(e).__name__}: {e}"]
+    covered: dict[str, set] = {}
+    for m in markers:
+        npz = os.path.join(d, _host_npz(int(m["host"])))
+        try:
+            digest = _sha256_file(npz)
+        except OSError as e:
+            problems.append(f"host {m['host']}: unreadable shard file "
+                            f"({e})")
+            continue
+        if digest != m.get("sha256"):
+            problems.append(f"host {m['host']}: shard file checksum "
+                            "mismatch (torn write?)")
+        for s in m.get("shards", ()):
+            covered.setdefault(s["leaf"], set()).add(
+                _index_key(s["index"]))
+    degrees = meta.get("degrees") or {}
+    for path, info in meta["leaves"].items():
+        want = set(
+            planner.leaf_shard_slices(
+                info["shape"], planner.spec_from_json(info.get("spec", [])),
+                degrees,
+            )
+        )
+        got = covered.get(path, set())
+        if got != want:
+            problems.append(
+                f"leaf {path}: shard coverage mismatch "
+                f"({len(got)} recorded vs {len(want)} expected slices)"
+            )
+    return problems
+
+
+def verify_directory(directory: str) -> dict:
+    """Sharded-format twin of ``resilience.verify_directory`` — same
+    report shape, so ``resilience.format_doctor`` renders it."""
+    steps = resilience.list_steps(directory)
+    chain = []
+    for s in reversed(steps):
+        if not is_complete(directory, s):
+            chain.append({"step": int(s), "ok": False, "verified": False,
+                          "problems": ["incomplete (missing host marker "
+                                       "— straggler or dead host)"]})
+            continue
+        problems = verify_step(directory, s)
+        chain.append({"step": int(s), "ok": not problems,
+                      "verified": not problems, "problems": problems})
+    quarantined = sorted(
+        name for name in (os.listdir(directory)
+                          if os.path.isdir(directory) else [])
+        if ".corrupt" in name and os.path.isdir(os.path.join(directory, name))
+    )
+    best = next((v["step"] for v in chain if v["ok"]), None)
+    return {
+        "directory": os.path.abspath(directory),
+        "steps": chain,
+        "quarantined": quarantined,
+        "healthy": best is not None,
+        "best_step": best,
+    }
+
+
+# package-level alias: training.verify_sharded_directory (the unsuffixed
+# name collides with resilience.verify_directory in training/__init__)
+verify_sharded_directory = verify_directory
+
+
+def tear_shard(directory: str, step: int, host: int = 0) -> bool:
+    """Chaos fault: truncate one host's shard file of a committed step in
+    place — what a crash between the array write and the fsync leaves.
+    The marker still carries the intact file's sha256, so verification
+    catches it and the step quarantines."""
+    path = os.path.join(step_dir(directory, step), _host_npz(host))
+    try:
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(size // 3)
+        return True
+    except OSError:
+        return False
+
+
+@dataclasses.dataclass
+class _SaveJob:
+    step: int
+    host: int
+    payload: bytes  # serialized npz
+    marker: dict
+    submitted: float
+
+
+class ShardedCheckpoint:
+    """Barrier-free per-host sharded checkpoints (module docstring).
+
+    CheckpointManager-protocol compatible: ``restore_or_init`` and the
+    Trainer drive it unchanged.  ``save`` extracts this host's replica-0
+    shards synchronously (donation-safe) and hands the durable write to
+    a background thread; ``wait()`` drains it.
+    """
+
+    def __init__(self, directory: str, *, max_to_keep: int = 3,
+                 integrity: bool = True, host: int | None = None,
+                 world: int | None = None):
+        """``host``/``world`` default to jax.process_index/count (the
+        real multi-controller deployment).  Setting them explicitly on a
+        single-process runtime enables **logical-host mode** — used by
+        the launch orchestrator on the CPU sim, where the backend cannot
+        run cross-process computations: each worker computes the full
+        (deterministic) trajectory on its own mesh, but persists only
+        the leaves it owns (stable hash of the leaf path mod world), so
+        the cross-process completion/integrity protocol is exercised
+        for real even though the collectives are not."""
+        self.directory = os.path.abspath(directory)
+        self.max_to_keep = max_to_keep
+        self.integrity = integrity
+        self._host = host
+        self._world = world
+        os.makedirs(self.directory, exist_ok=True)
+        self._q: "queue.Queue[_SaveJob]" = queue.Queue()
+        self._thread: threading.Thread | None = None
+        self._error: BaseException | None = None
+        self._closed = False
+
+    # -- async writer -------------------------------------------------------
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._writer_loop, daemon=True,
+                name="tadnn-shard-writer")
+            self._thread.start()
+
+    def _writer_loop(self) -> None:
+        while True:
+            job = self._q.get()
+            try:
+                if job is not None:
+                    self._finalize(job)
+            except BaseException as e:  # surfaced by wait()/next save
+                self._error = e
+            finally:
+                self._q.task_done()
+            if job is None:
+                return
+
+    def _finalize(self, job: _SaveJob) -> None:
+        t0 = time.monotonic()
+        d = step_dir(self.directory, job.step)
+        npz_path = os.path.join(d, _host_npz(job.host))
+        _fsync_write(npz_path, job.payload)
+        job.marker["sha256"] = _sha256_file(npz_path)
+        job.marker["written_at"] = time.time()
+        _fsync_write(os.path.join(d, _host_marker(job.host)),
+                     json.dumps(job.marker).encode())
+        obs_journal.event(
+            "ckpt.async_save", step=int(job.step), host=int(job.host),
+            queue_depth=self._q.qsize(),
+            off_thread_s=round(time.monotonic() - t0, 6),
+            dispatch_to_durable_s=round(time.monotonic() - job.submitted, 6),
+            bytes=len(job.payload),
+        )
+        if job.host == 0:
+            self._gc()
+
+    def _raise_pending(self) -> None:
+        if self._error is not None:
+            e, self._error = self._error, None
+            raise e
+
+    # -- protocol -----------------------------------------------------------
+
+    def save(self, step: int, state: Any, config: dict | None = None,
+             force: bool = False) -> bool:
+        import jax
+
+        from .checkpoint import _encode_keys
+
+        self._raise_pending()
+        step = int(step)
+        if is_complete(self.directory, step) and not force:
+            return False
+        host = jax.process_index() if self._host is None else int(self._host)
+        world = (jax.process_count() if self._world is None
+                 else int(self._world))
+        logical = self._world is not None and jax.process_count() == 1
+        with obs_journal.span("ckpt.save", step=step, sharded=True) as rec:
+            encoded = _encode_keys(state)
+            flat, _ = jax.tree_util.tree_flatten_with_path(encoded)
+            d = step_dir(self.directory, step)
+            os.makedirs(d, exist_ok=True)
+            shards: list[dict] = []
+            arrays: dict[str, np.ndarray] = {}
+            leaves_meta: dict[str, dict] = {}
+            for kp, leaf in flat:
+                path = resilience._norm_keypath(kp)
+                spec = getattr(getattr(leaf, "sharding", None), "spec", None)
+                leaves_meta[path] = {
+                    "shape": list(leaf.shape),
+                    "dtype": str(leaf.dtype),
+                    "spec": (self._spec_json(spec)
+                             if spec is not None else []),
+                }
+                if logical and _leaf_owner(path, world) != host:
+                    continue  # another logical host persists this leaf
+                for sh in self._replica0_shards(leaf):
+                    key = f"s{len(shards)}"
+                    # copy to host NOW: the caller's next step may donate
+                    # (and invalidate) these buffers before the writer
+                    # thread runs
+                    data = np.ascontiguousarray(np.asarray(sh.data))
+                    arrays[key] = data.view(np.uint8).reshape(-1)
+                    shards.append({
+                        "k": key,
+                        "leaf": path,
+                        "index": _slices_to_index(sh.index, leaf.shape),
+                        "dtype": str(leaf.dtype),
+                    })
+            if host == 0:
+                self._write_meta(step, world, config, leaves_meta, encoded)
+            import io
+
+            buf = io.BytesIO()
+            np.savez(buf, **arrays)
+            job = _SaveJob(
+                step=step, host=host, payload=buf.getvalue(),
+                marker={"version": SHARD_FORMAT_VERSION, "step": step,
+                        "host": host, "world": world, "shards": shards},
+                submitted=time.monotonic(),
+            )
+            self._ensure_thread()
+            self._q.put(job)
+            rec["queued"] = True
+            rec["n_shards"] = len(shards)
+        return True
+
+    @staticmethod
+    def _spec_json(spec) -> list:
+        from .. import planner
+
+        return planner.spec_to_json(spec)
+
+    @staticmethod
+    def _replica0_shards(leaf) -> list:
+        """The replica-0 addressable shards of a leaf — together the
+        distinct data this process must persist (other replicas hold
+        identical bytes and some other host/device persists nothing)."""
+        shards = getattr(leaf, "addressable_shards", None)
+        if not shards:
+            # host numpy scalar/array (shouldn't happen for TrainState
+            # leaves, but stay total): treat as one full replica
+            class _Whole:
+                def __init__(self, x):
+                    self.data = np.asarray(x)
+                    self.index = tuple(slice(None) for _ in self.data.shape)
+
+            return [_Whole(leaf)]
+        return [s for s in shards if s.replica_id == 0]
+
+    def _write_meta(self, step: int, world: int, config: dict | None,
+                    leaves_meta: dict, encoded_state: Any) -> None:
+        from .. import topology as topo_mod
+
+        degrees: dict[str, int] = {}
+        import jax
+
+        for leaf in jax.tree.leaves(encoded_state):
+            mesh = getattr(getattr(leaf, "sharding", None), "mesh", None)
+            if mesh is not None:
+                degrees = dict(topo_mod.mesh_degrees(mesh))
+                break
+        meta = {
+            "version": SHARD_FORMAT_VERSION,
+            "step": int(step),
+            "world": int(world),
+            "degrees": degrees,
+            "written_at": time.time(),
+            "config": config if config is not None else {},
+            "leaves": leaves_meta,
+        }
+        _fsync_write(os.path.join(step_dir(self.directory, step), _META),
+                     json.dumps(meta).encode())
+
+    def latest_step(self) -> int | None:
+        steps = list_complete_steps(self.directory)
+        return steps[-1] if steps else None
+
+    def all_steps(self) -> list[int]:
+        return list_complete_steps(self.directory)
+
+    def reload(self) -> None:  # directory is rescanned on every call
+        return None
+
+    def wait(self) -> None:
+        with obs_journal.span("ckpt.wait", sharded=True):
+            self._q.join()
+        self._raise_pending()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._q.join()
+        if self._thread is not None and self._thread.is_alive():
+            self._q.put(None)
+            self._thread.join(timeout=10)
+        self._closed = True
+
+    def quarantine(self, step: int, reason: str = "") -> None:
+        self._q.join()  # never rename under the writer
+        resilience.quarantine_step(self.directory, step, reason)
+
+    def _gc(self) -> None:
+        steps = list_complete_steps(self.directory)
+        for s in steps[:-self.max_to_keep] if self.max_to_keep else []:
+            import shutil
+
+            shutil.rmtree(step_dir(self.directory, s), ignore_errors=True)
+
+    # -- restore ------------------------------------------------------------
+
+    def restore(self, abstract_state: Any, step: int | None = None, *,
+                verify: bool | None = None) -> Any:
+        """Reassemble every leaf from all hosts' shards and re-slice it
+        through the TARGET shardings carried by ``abstract_state`` —
+        resharding across mesh/plan changes is the normal path, not a
+        special case."""
+        import jax
+
+        from .checkpoint import _decode_keys, _encode_abstract_keys
+
+        self._raise_pending()
+        step = self.latest_step() if step is None else int(step)
+        if step is None:
+            raise FileNotFoundError(
+                f"No complete sharded checkpoint in {self.directory}")
+        verify = self.integrity if verify is None else verify
+        with obs_journal.span("ckpt.restore", step=step,
+                              sharded=True) as rec:
+            if not is_complete(self.directory, step):
+                raise FileNotFoundError(
+                    f"step {step} in {self.directory} is incomplete")
+            if verify:
+                problems = verify_step(self.directory, step)
+                rec["verified"] = not problems
+                if problems:
+                    raise resilience.CheckpointCorruptError(
+                        f"sharded step {step} failed verification: "
+                        + "; ".join(problems[:4])
+                        + (f" (+{len(problems) - 4} more)"
+                           if len(problems) > 4 else "")
+                    )
+            meta = read_meta(self.directory, step)
+            assembled = self._assemble(step, meta)
+            encoded_abs = _encode_abstract_keys(abstract_state)
+            flat, treedef = jax.tree_util.tree_flatten_with_path(encoded_abs)
+            leaves = []
+            for kp, ab in flat:
+                path = resilience._norm_keypath(kp)
+                if path not in assembled:
+                    raise KeyError(
+                        f"leaf {path} missing from sharded step {step}")
+                arr = assembled[path]
+                if tuple(arr.shape) != tuple(ab.shape):
+                    raise ValueError(
+                        f"leaf {path}: checkpoint shape {arr.shape} vs "
+                        f"target {ab.shape}")
+                arr = arr.astype(ab.dtype, copy=False)
+                sharding = getattr(ab, "sharding", None)
+                if sharding is None:
+                    leaves.append(jax.numpy.asarray(arr))
+                else:
+                    leaves.append(jax.make_array_from_callback(
+                        tuple(ab.shape), sharding, lambda idx, a=arr: a[idx]
+                    ))
+            out = jax.tree_util.tree_unflatten(treedef, leaves)
+        return _decode_keys(out, abstract_state)
+
+    def _assemble(self, step: int, meta: dict) -> dict[str, np.ndarray]:
+        """Full host arrays per leaf path, from every host's shard file."""
+        d = step_dir(self.directory, step)
+        out: dict[str, np.ndarray] = {}
+        for m in _read_markers(self.directory, step, int(meta["world"])):
+            with np.load(os.path.join(d, _host_npz(int(m["host"])))) as z:
+                for s in m.get("shards", ()):
+                    path = s["leaf"]
+                    info = meta["leaves"].get(path)
+                    if info is None:
+                        raise KeyError(f"shard for unknown leaf {path}")
+                    if path not in out:
+                        out[path] = np.empty(
+                            tuple(info["shape"]),
+                            dtype=_np_dtype(info["dtype"]))
+                    idx = tuple(slice(a, b) for a, b in s["index"])
+                    shape = tuple(b - a for a, b in s["index"])
+                    data = z[s["k"]].tobytes()
+                    out[path][idx] = np.frombuffer(
+                        data, dtype=_np_dtype(s["dtype"])).reshape(shape)
+        return out
+
+    def restore_config(self, step: int | None = None) -> dict | None:
+        step = self.latest_step() if step is None else int(step)
+        if step is None:
+            return None
+        meta = read_meta(self.directory, step)
+        if meta is None:
+            obs_journal.event("ckpt.restore_config_failed", step=int(step),
+                              error="missing or torn meta.json")
+            return None
+        return meta.get("config")
+
+    def __enter__(self) -> "ShardedCheckpoint":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -67,6 +67,13 @@ class TrainerConfig:
     # reporting to stderr
     watchdog_escalate: bool = False
     heartbeat_dir: str = ""  # "" = off; shared-dir liveness beats
+    # heartbeat cadence; the launcher's watchdog grace must be a few
+    # multiples of this, so fast smoke runs shrink both together
+    heartbeat_interval_s: float = 10.0
+    # heartbeat host id; None = jax.process_index().  The launcher's
+    # logical-host workers (training/launch.py) share process index 0,
+    # so each passes its own cohort rank here
+    heartbeat_host: "int | None" = None
     eval_every: int = 0  # 0 = off; run evaluate(eval_data) every N steps
     eval_batches: int = 8  # batches per periodic evaluation
     preempt_drain: bool = True  # SIGTERM -> checkpoint + clean return
@@ -307,7 +314,9 @@ class Trainer:
         on_stall = (self._stall_escalator() if cfg.watchdog_escalate
                     else None)
         guard = AnomalyGuard(cfg.anomaly) if cfg.anomaly else None
-        heartbeat = (Heartbeat(cfg.heartbeat_dir).start()
+        heartbeat = (Heartbeat(cfg.heartbeat_dir,
+                               interval_s=cfg.heartbeat_interval_s,
+                               host_index=cfg.heartbeat_host).start()
                      if cfg.heartbeat_dir else None)
         self.preempt = (PreemptionGuard().install()
                         if cfg.preempt_drain else None)
