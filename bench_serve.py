@@ -27,6 +27,15 @@ Usage (all key=value, bench.py-style):
 
     python bench_serve.py [streams=8] [slots=4] [prompt_len=12]
         [max_new=16] [block_size=8] [quant_kv=0] [seed=0]
+        [attention_impl=paged|dense] [prefill_chunk=32]
+
+r02 adds a per-step component breakdown (``extra["breakdown"]``):
+gather / attention / scatter milliseconds per decode step measured by
+micro-benching the step's per-layer pieces on the engine's own pool
+arrays, plus mean decode-step and prefill-chunk latency from the run's
+journal.  On the paged path ``gather_ms_per_step`` is 0.0 by
+construction — the fused kernel (ops/paged_attention.py) reads the
+block table in-kernel and the dense view is never materialized.
 """
 
 from __future__ import annotations
@@ -49,7 +58,7 @@ def parse_args():
     args = {
         "streams": 8, "slots": 4, "prompt_len": 12, "max_new": 16,
         "block_size": 8, "max_len": 64, "quant_kv": 0, "seed": 0,
-        "vocab": 128,
+        "vocab": 128, "attention_impl": "paged", "prefill_chunk": 32,
     }
     for item in sys.argv[1:]:
         k, _, v = item.partition("=")
@@ -98,6 +107,80 @@ def _pct(sorted_vals, q):
                            max(0, math.ceil(q * len(sorted_vals)) - 1))]
 
 
+def _time_ms(fn, *xs, reps: int = 20) -> float:
+    """Mean wall ms per call of an already-jitted ``fn`` (one warmup
+    call pays compile outside the timed window)."""
+    import jax
+
+    jax.block_until_ready(fn(*xs))
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(reps):
+        out = fn(*xs)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps * 1e3
+
+
+def _component_breakdown(eng, impl: str) -> dict:
+    """Micro-bench the decode step's per-layer pieces on the engine's
+    own pool arrays: gather (dense view materialization), attention
+    (the chosen impl's kernel), scatter (the token write).  Numbers are
+    ms per WHOLE decode step (x n_layers, x2 sides where both k and v
+    pay), a synthetic full-occupancy state (every slot at max context)
+    so the gather cost is the worst case the paged kernel deletes."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torch_automatic_distributed_neural_network_tpu.inference.serve \
+        .kv_pool import gather_blocks, write_token
+    from torch_automatic_distributed_neural_network_tpu.ops.attention \
+        import xla_attention
+    from torch_automatic_distributed_neural_network_tpu.ops \
+        .paged_attention import paged_attention
+
+    cfg = eng.cfg
+    S, MB, bs = eng.n_slots, eng.max_blocks, eng.pool.block_size
+    L = cfg.n_layers
+    nb = eng.pool.num_blocks
+    tables = np.zeros((S, MB), np.int32)
+    for s in range(S):
+        for j in range(MB):
+            tables[s, j] = 1 + (s * MB + j) % (nb - 1)
+    tables = jnp.asarray(tables)
+    ctx = jnp.full((S,), eng.max_len - 1, jnp.int32)
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(S, cfg.n_heads, cfg.head_dim), jnp.float32)
+    new = jnp.asarray(rs.randn(S, cfg.kv_heads, cfg.head_dim),
+                      jnp.float32)
+    k0 = jax.tree.map(lambda x: x[0], eng.pool.kv["k"])
+    v0 = jax.tree.map(lambda x: x[0], eng.pool.kv["v"])
+
+    gather = jax.jit(lambda kl, t: gather_blocks(kl, t, cfg.dtype))
+    t_gather = _time_ms(gather, k0, tables)
+    scatter = jax.jit(lambda kl, t, p, x: write_token(kl, t, p, x))
+    t_scatter = _time_ms(scatter, k0, tables, ctx, new)
+    if impl == "paged":
+        attn = jax.jit(lambda qq, kl, vl, t, c: paged_attention(
+            qq, kl, vl, t, c, window=cfg.sliding_window))
+        t_attn = _time_ms(attn, q, k0, v0, tables, ctx)
+        t_gather_step = 0.0  # eliminated: the kernel reads the table
+    else:
+        kd, vd = gather(k0, tables), gather(v0, tables)
+        key_idx = jnp.arange(kd.shape[1])[None, :]
+        mask = (key_idx <= ctx[:, None])[:, None, None, :]
+        attn = jax.jit(lambda qq, k_, v_: xla_attention(
+            qq[:, None], k_, v_, causal=False, mask=mask))
+        t_attn = _time_ms(attn, q, kd, vd)
+        t_gather_step = 2 * L * t_gather
+    return {
+        "gather_ms_per_step": round(t_gather_step, 3),
+        "gather_ms_per_call": round(t_gather, 3),  # what dense would pay
+        "attention_ms_per_step": round(L * t_attn, 3),
+        "scatter_ms_per_step": round(2 * L * t_scatter, 3),
+    }
+
+
 def run_load(args, journal) -> dict:
     import jax
     import jax.numpy as jnp
@@ -116,12 +199,16 @@ def run_load(args, journal) -> dict:
     variables = model.init(jax.random.key(1),
                            jnp.asarray(prompt0, jnp.int32))
 
+    impl = str(args["attention_impl"])
+    chunk = int(args["prefill_chunk"]) or None  # 0 -> single-shot
     eng = ServeEngine(
         model, variables,
         n_slots=int(args["slots"]),
         max_len=int(args["max_len"]),
         block_size=int(args["block_size"]),
         quant_kv=bool(int(args["quant_kv"])),
+        attention_impl=impl,
+        prefill_chunk=chunk,
         journal=journal,
     )
     for _ in range(int(args["streams"])):
@@ -138,6 +225,23 @@ def run_load(args, journal) -> dict:
 
     totals = sorted((r.t_done or 0.0) - r.t_submit for r in done)
     new_tokens = sum(r.n_generated for r in done)
+
+    # per-step breakdown: journal means for the run's real steps plus a
+    # component micro-bench on the engine's own pool arrays
+    decode_ts = [r["decode_s"] for r in journal.named("serve.step")
+                 if r.get("decode_s")]
+    chunk_ts = [r["seconds"] for r in journal.named("serve.prefill_chunk")
+                if r.get("seconds") is not None]
+    # the first record of each pays trace+compile — not a serving number
+    decode_ts = decode_ts[1:] if len(decode_ts) > 1 else decode_ts
+    chunk_ts = chunk_ts[1:] if len(chunk_ts) > 1 else chunk_ts
+    breakdown = _component_breakdown(eng, impl)
+    breakdown["decode_step_ms"] = (
+        round(1e3 * sum(decode_ts) / len(decode_ts), 3)
+        if decode_ts else None)
+    breakdown["prefill_chunk_ms"] = (
+        round(1e3 * sum(chunk_ts) / len(chunk_ts), 3)
+        if chunk_ts else None)
     device_kind = jax.devices()[0].device_kind
     on_cpu = jax.default_backend() == "cpu"
     metric = "serve_tokens_per_sec" + ("_cpu_sim" if on_cpu else "")
@@ -158,6 +262,9 @@ def run_load(args, journal) -> dict:
             "max_new": int(args["max_new"]),
             "block_size": int(args["block_size"]),
             "quant_kv": bool(int(args["quant_kv"])),
+            "attention_impl": impl,
+            "prefill_chunk": chunk,
+            "breakdown": breakdown,
             "n_requests": len(done),
             "new_tokens": new_tokens,
             "wall_s": round(wall, 4),

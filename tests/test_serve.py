@@ -176,9 +176,13 @@ def test_finished_on_eos_and_budget():
 
 
 @pytest.mark.slow
-def test_continuous_batching_matches_sequential_generate(devices8):
+@pytest.mark.parametrize("attention_impl", ["paged", "dense"])
+def test_continuous_batching_matches_sequential_generate(
+        devices8, attention_impl):
     """Token parity: mixed-length requests through 3 slots must emit
-    exactly the tokens greedy generate() emits one request at a time."""
+    exactly the tokens greedy generate() emits one request at a time —
+    under BOTH decode paths (the fused paged kernel and the dense
+    gather_blocks reference)."""
     model, variables = _model_and_vars()
     rs = np.random.RandomState(42)
     prompts = [[int(t) for t in rs.randint(1, VOCAB, size=(p,))]
@@ -186,7 +190,7 @@ def test_continuous_batching_matches_sequential_generate(devices8):
     max_new = 12
 
     eng = ServeEngine(model, variables, n_slots=3, max_len=64,
-                      block_size=8)
+                      block_size=8, attention_impl=attention_impl)
     reqs = [eng.submit(p, max_new_tokens=max_new, eos_id=0)
             for p in prompts]
     done = eng.run()
@@ -284,6 +288,40 @@ def test_report_renders_serving_section(tmp_path):
     assert "p50" in text and "p99" in text and "goodput" in text
 
 
+def test_report_renders_serving_breakdown(tmp_path):
+    """r02 fields: the engine-config event, per-step phase timings and
+    prefill-chunk latency land in the serving section."""
+    jp = tmp_path / "journal.jsonl"
+    recs = [{"kind": "event", "name": "serve.engine", "t": 0.0,
+             "attention_impl": "paged", "prefill_chunk": 32,
+             "n_slots": 4, "max_len": 64, "block_size": 8,
+             "quant_kv": False}]
+    recs += [{"kind": "event", "name": "serve.step", "t": 0.1 * i,
+              "step": i, "n_active": 2, "n_queued": 0,
+              "n_prefilling": 1, "occupancy": 0.5, "free_blocks": 3,
+              "prefill_s": 0.02, "decode_s": 0.01} for i in range(1, 4)]
+    recs += [{"kind": "event", "name": "serve.prefill_chunk",
+              "t": 0.05 * i, "rid": 0, "slot": 1, "pos": 32 * i,
+              "n_tokens": 32, "seconds": 0.02, "done": i == 2}
+             for i in (1, 2)]
+    recs += [{"kind": "event", "name": "serve.request", "t": 0.4,
+              "rid": 0, "n_prompt": 40, "n_new": 6, "queue_s": 0.01,
+              "prefill_s": 0.05, "decode_s": 0.2, "total_s": 0.26,
+              "tokens_per_s": 30.0, "preempted": 0}]
+    with open(jp, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    srv = obs_report.generate(str(jp))["serving"]
+    assert srv["attention_impl"] == "paged"
+    assert srv["prefill_chunk"] == 32
+    assert srv["mean_decode_step_s"] == pytest.approx(0.01)
+    assert srv["mean_prefill_chunk_s"] == pytest.approx(0.02)
+    assert srv["n_prefill_chunks"] == 2
+    text = obs_report.format_report(obs_report.generate(str(jp)))
+    assert "decode impl paged" in text
+    assert "prefill chunk" in text
+
+
 @pytest.mark.slow
 def test_engine_journals_render_end_to_end(tmp_path, devices8):
     from torch_automatic_distributed_neural_network_tpu.obs.journal import (
@@ -348,6 +386,23 @@ def test_serve_estimate_int8_kv_shrinks_blocks():
                              quant_kv=True)
     assert int8["block_bytes_per_device"] < dense["block_bytes_per_device"]
     assert int8["max_streams"] > dense["max_streams"]
+
+
+def test_serve_estimate_dense_charges_gather_workspace():
+    """attention_impl='dense' budgets the per-step gathered k+v views
+    (and can only lose streams for it); paged charges exactly 0."""
+    _, paged = serve_estimate(_cfg(), budget="1MiB", headroom=0.0,
+                              block_size=16, max_len=256, streams=3)
+    _, dense = serve_estimate(_cfg(), budget="1MiB", headroom=0.0,
+                              block_size=16, max_len=256, streams=3,
+                              attention_impl="dense")
+    assert paged["attention_impl"] == "paged"
+    assert paged["decode_workspace_bytes"] == 0
+    # 3 streams x 2 sides x 256 tokens x 4 kvH x 32 hd x 2 B = 384 KiB
+    assert dense["decode_workspace_bytes"] == 3 * 2 * 256 * 4 * 32 * 2
+    assert dense["max_streams"] <= paged["max_streams"]
+    with pytest.raises(ValueError, match="attention_impl"):
+        serve_estimate(_cfg(), budget="1MiB", attention_impl="fused")
 
 
 # -- SERVE bench freshness family ---------------------------------------------

@@ -62,6 +62,7 @@ def serve_estimate(cfg, *,
                    streams: int | None = None,
                    quant_kv: bool = False,
                    params_bytes: int = 0,
+                   attention_impl: str = "paged",
                    degrees: Mapping[str, int] | None = None,
                    ) -> tuple[list[Finding], dict[str, Any]]:
     """(findings, estimate) for a serving deployment of ``cfg``.
@@ -70,10 +71,19 @@ def serve_estimate(cfg, *,
     layout); ``degrees`` shards only the KV pool's head axis, matching
     ``cache_partition_spec``.  ``streams`` is the requested concurrency
     — when given, fitting fewer is an ML005 warning.
+
+    ``attention_impl`` matches the engine's knob: the ``"dense"`` decode
+    path materializes one layer's gathered K and V views per step
+    ([S, max_len, kvH, hd] bf16 each — ``kv_pool.gather_blocks``), a
+    transient workspace charged against the pool budget here;
+    ``"paged"`` (default) reads blocks in-kernel
+    (ops/paged_attention.py) so its workspace is exactly 0 bytes.
     """
     from ..inference.serve.kv_pool import blocks_for_tokens
     from .mem_lint import sharded_tree_bytes
 
+    if attention_impl not in ("paged", "dense"):
+        raise ValueError(f"unknown attention_impl {attention_impl!r}")
     degrees = dict(degrees or {})
     budget_bytes = resolve_budget(budget)
     side, side_spec = _pool_specs(cfg, degrees, quant_kv)
@@ -88,7 +98,23 @@ def serve_estimate(cfg, *,
     # one block is the reserved null block (kv_pool.NULL_BLOCK)
     max_streams = max(0, (num_blocks - 1) // blocks_per_stream)
 
+    decode_workspace_bytes = 0
+    if attention_impl == "dense":
+        # one layer's gathered k+v dense views, alive during every
+        # decode step; shards over the head axis like the pool
+        t = int(degrees.get("tensor", 1))
+        shard = t if t > 1 and cfg.kv_heads % t == 0 else 1
+        per_stream_ws = 2 * max_len * cfg.kv_heads * cfg.head_dim * 2
+        per_stream_ws //= shard
+        n_ws = streams if streams is not None else max_streams
+        decode_workspace_bytes = int(per_stream_ws * n_ws)
+        num_blocks = max(
+            0, (usable - decode_workspace_bytes) // max(1, block_bytes_dev))
+        max_streams = max(0, (num_blocks - 1) // blocks_per_stream)
+
     est: dict[str, Any] = {
+        "attention_impl": attention_impl,
+        "decode_workspace_bytes": decode_workspace_bytes,
         "budget_bytes": int(budget_bytes),
         "headroom": headroom,
         "params_bytes": int(params_bytes),
@@ -126,7 +152,10 @@ def serve_estimate(cfg, *,
             f"{max_streams} fit ({num_blocks} blocks / "
             f"{blocks_per_stream} per stream)"
             + ("" if quant_kv else "; --quant-kv (int8 KV) ~doubles "
-               "capacity")))
+               "capacity")
+            + ("" if attention_impl == "paged" else
+               "; attention_impl=paged frees the "
+               f"{_fmt_bytes(decode_workspace_bytes)} gather workspace")))
 
     from ..obs import journal as obs_journal
 

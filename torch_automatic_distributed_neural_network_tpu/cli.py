@@ -706,6 +706,7 @@ def cmd_check(args: argparse.Namespace) -> int:
             max_len=args.serve_max_len or args.seq or 256,
             streams=args.serve_streams,
             quant_kv=args.serve_quant_kv,
+            attention_impl=args.serve_attention_impl,
             params_bytes=params_bytes, **kwargs)
         findings += s_findings
     try:
@@ -729,11 +730,15 @@ def cmd_check(args: argparse.Namespace) -> int:
         if mem_report is not None:
             _print_memory_report(mem_report)
         if serve_est is not None:
+            ws = serve_est.get("decode_workspace_bytes", 0)
             print(f"serve estimate: {serve_est['max_streams']} "
                   f"concurrent stream(s) of {serve_est['max_len']} "
                   f"tokens ({serve_est['num_blocks']} blocks x "
                   f"{serve_est['block_size']}, "
-                  f"{'int8' if serve_est['quant_kv'] else 'bf16'} KV)")
+                  f"{'int8' if serve_est['quant_kv'] else 'bf16'} KV, "
+                  f"{serve_est.get('attention_impl', 'paged')} decode"
+                  + (f", {ws // 1024} KiB gather workspace" if ws
+                     else "") + ")")
         print(f"tadnn check: {summary['errors']} error(s), "
               f"{summary['warnings']} warning(s)")
     return analysis.exit_code(findings, strict=args.strict)
@@ -796,6 +801,8 @@ def cmd_serve(args: argparse.Namespace) -> int:
             max_len=max_len,
             block_size=args.block_size or 16,
             quant_kv=args.quant_kv,
+            attention_impl=args.attention_impl,
+            prefill_chunk=args.prefill_chunk or None,
             admission=args.admission,
             journal=jnl,
         )
@@ -831,6 +838,8 @@ def cmd_serve(args: argparse.Namespace) -> int:
                                else None),
             "preemptions": eng.scheduler.n_preemptions,
             "quant_kv": args.quant_kv,
+            "attention_impl": eng.attention_impl,
+            "prefill_chunk": eng.prefill_chunk,
             "journal": args.journal,
         }
     print(json.dumps(summary))
@@ -1042,6 +1051,15 @@ def main(argv: list[str] | None = None) -> int:
                    dest="block_size", help="KV pool block size (tokens)")
     p.add_argument("--quant-kv", action="store_true", dest="quant_kv",
                    help="int8 KV blocks (inference/quant.quantize_kv)")
+    p.add_argument("--attention-impl", default="paged",
+                   choices=("paged", "dense"), dest="attention_impl",
+                   help="decode attention: fused paged kernel "
+                        "(ops/paged_attention) or the dense "
+                        "gather_blocks reference path")
+    p.add_argument("--prefill-chunk", type=int, default=32,
+                   dest="prefill_chunk",
+                   help="chunked-prefill chunk size (0 = legacy "
+                        "single-shot prefill)")
     p.add_argument("--admission", default="reserve",
                    choices=("reserve", "optimistic"),
                    help="block admission policy (scheduler.py)")
@@ -1180,6 +1198,11 @@ def main(argv: list[str] | None = None) -> int:
                    help="tokens per stream (default: --seq or 256)")
     p.add_argument("--serve-quant-kv", action="store_true",
                    dest="serve_quant_kv", help="int8 KV pool")
+    p.add_argument("--serve-attention-impl", default="paged",
+                   choices=("paged", "dense"),
+                   dest="serve_attention_impl",
+                   help="decode path to budget: dense charges the "
+                        "per-step gather workspace, paged charges 0")
     p.add_argument("--zero1", action="store_true",
                    help="ZeRO-1 for --memory: shard optimizer moments "
                         "over the data axis (the per-chip optimizer row "

@@ -121,6 +121,21 @@ def dequantize_kv(qkv, dtype=jnp.bfloat16):
     return dequantize_leaf(qkv, dtype)
 
 
+def kv_leaf_parts(x):
+    """``(payload, scale | None)`` view of a KV-pool leaf.
+
+    This is the storage contract the fused paged-attention kernel
+    (ops/paged_attention.py) consumes IN-KERNEL: the int8 payload and
+    its per-(token, head) fp32 scales stream into VMEM as separate
+    operands and multiply right before the dot, so the dense bf16 form
+    of a block never materializes in HBM.  Dense (fp) leaves have no
+    scale pass at all — callers skip dequantize entirely.
+    """
+    if is_quantized_leaf(x):
+        return x["q"], x["scale"]
+    return x, None
+
+
 def embedding_lookup(emb, tokens, dtype=jnp.bfloat16):
     """Gather-then-dequantize: only the LOOKED-UP rows convert, the
     [V, d] table itself stays int8 in HBM."""

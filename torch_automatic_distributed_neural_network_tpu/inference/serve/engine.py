@@ -19,13 +19,26 @@ which this step differs in exactly three ways:
   / ``write_token``) instead of a contiguous cache strip;
 - sampled tokens are masked to 0 on inactive slots.
 
-Prefill reuses ``forward_cached`` itself on a [1, P] dense temp cache,
-then copies the rows into the request's blocks — numerically the exact
+Prefill reuses ``forward_cached`` itself on a dense temp cache, then
+copies the rows into the request's blocks — numerically the exact
 prefill ``generate()`` runs, which is what makes token-parity with
 sequential generation testable (greedy decoding is deterministic; for
 stochastic sampling the engine is reproducible under its own rng but
 not per-request-identical to ``generate()``, since one categorical
-call samples all slots).
+call samples all slots).  By default prefill is CHUNKED: the prompt
+streams through one jitted [1, C]-chunk trace against a fixed
+[1, max_len] temp cache (C snapped to a divisor of max_len), one chunk
+per engine step per prefilling slot, INTERLEAVED with decode — a long
+prompt no longer stalls every running request for its whole prefill,
+and no per-prompt-length retrace exists.  ``prefill_chunk=None``
+restores the legacy single-shot prefill (one [1, P] pass at
+admission, one trace per distinct P).
+
+The decode-step attention is config-gated (``attention_impl``):
+``"paged"`` (default) runs the fused Pallas kernel that reads the
+block table in-kernel (ops/paged_attention.py — no dense gather);
+``"dense"`` keeps the reference ``gather_blocks`` + ``xla_attention``
+path the kernel is parity-pinned against.
 
 Telemetry: every finished request journals a ``serve.request`` event
 (queue/prefill/decode/total seconds, tokens/s, preemption count) and
@@ -36,6 +49,8 @@ and occupancy from exactly these records.
 
 from __future__ import annotations
 
+import dataclasses
+import math
 import time
 from functools import partial
 from typing import Any
@@ -72,10 +87,23 @@ from .scheduler import Request, Scheduler
 def _paged_decode_step(params, kv, tables, ctx_lens, last_tok, active,
                        rng, *, cfg: TransformerConfig,
                        sample: SampleConfig, moe_decode: str,
+                       attention_impl: str = "paged",
                        mesh=None, spec=None):
     """One token for every slot.  [S] vectors throughout; static shapes
-    (S slots, tables [S, max_blocks]) so this traces exactly once."""
+    (S slots, tables [S, max_blocks]) so this traces exactly once.
+
+    ``attention_impl`` picks the per-layer KV read:
+
+    - ``"paged"`` (default): the fused Pallas kernel
+      (ops/paged_attention.py) reads the block table in-kernel — the
+      dense gathered view never materializes, int8 dequantize happens
+      on load inside the kernel;
+    - ``"dense"``: the reference path — ``gather_blocks`` to a dense
+      [S, max_len] view, then stock ``xla_attention`` under an explicit
+      mask.  Kept as the parity oracle and the fallback.
+    """
     from ...ops.attention import xla_attention
+    from ...ops.paged_attention import paged_attention
 
     dtype = cfg.dtype
     norm = make_norm(cfg)
@@ -95,17 +123,19 @@ def _paged_decode_step(params, kv, tables, ctx_lens, last_tok, active,
         pe = params["pos_embed"].astype(dtype)
         x = x + pe[positions]
 
-    n_keys = tables.shape[1] * (
-        kv["k"]["q"] if is_quantized_leaf(kv["k"]) else kv["k"]
-    ).shape[2]
-    key_idx = jnp.arange(n_keys)[None, :]
-    # the step writes this token at ctx_lens, then attends keys
-    # 0..ctx_lens inclusive; table padding beyond a slot's blocks
-    # gathers null-block garbage that this mask never admits
-    mask = key_idx <= ctx_lens[:, None]
-    if cfg.sliding_window is not None:
-        mask &= key_idx > ctx_lens[:, None] - cfg.sliding_window
-    mask = mask[:, None, None, :]  # [S, 1, 1, K]
+    mask = None
+    if attention_impl == "dense":
+        n_keys = tables.shape[1] * (
+            kv["k"]["q"] if is_quantized_leaf(kv["k"]) else kv["k"]
+        ).shape[2]
+        key_idx = jnp.arange(n_keys)[None, :]
+        # the step writes this token at ctx_lens, then attends keys
+        # 0..ctx_lens inclusive; table padding beyond a slot's blocks
+        # gathers null-block garbage that this mask never admits
+        mask = key_idx <= ctx_lens[:, None]
+        if cfg.sliding_window is not None:
+            mask &= key_idx > ctx_lens[:, None] - cfg.sliding_window
+        mask = mask[:, None, None, :]  # [S, 1, 1, K]
 
     def layer(x, xs):
         lp, k_layer, v_layer = xs
@@ -115,9 +145,16 @@ def _paged_decode_step(params, kv, tables, ctx_lens, last_tok, active,
             {"params": lp["attn"]}, h, positions, method="qkv")
         k_layer = write_token(k_layer, tables, ctx_lens, k[:, 0])
         v_layer = write_token(v_layer, tables, ctx_lens, v[:, 0])
-        kd = gather_blocks(k_layer, tables, dtype)
-        vd = gather_blocks(v_layer, tables, dtype)
-        o = xla_attention(q, kd, vd, causal=False, mask=mask)
+        if attention_impl == "paged":
+            # fused path: block table consumed in-kernel, same ctx/window
+            # mask semantics, no [S, max_len] gather
+            o = paged_attention(
+                q[:, 0], k_layer, v_layer, tables, ctx_lens,
+                window=cfg.sliding_window)[:, None]
+        else:
+            kd = gather_blocks(k_layer, tables, dtype)
+            vd = gather_blocks(v_layer, tables, dtype)
+            o = xla_attention(q, kd, vd, causal=False, mask=mask)
         x = x + attn.apply(
             {"params": lp["attn"]}, o.astype(dtype), method="out_proj")
         h = norm.apply({"params": lp["mlp_norm"]}, x)
@@ -147,6 +184,36 @@ def _paged_decode_step(params, kv, tables, ctx_lens, last_tok, active,
     return {"k": new_k, "v": new_v}, nxt
 
 
+def _prefill_chunk_step(params, tokens, cache, last_idx, *,
+                        cfg: TransformerConfig, moe_decode: str):
+    """One fixed-shape prefill chunk: [1, C] tokens through
+    ``forward_cached`` against the fixed [1, max_len] temp cache.
+
+    Every chunk of every prompt reuses this ONE jitted trace: the chunk
+    length is constant and both the cache cursor (``cache.length``) and
+    ``last_idx`` are traced scalars.  The final chunk of a prompt may
+    be right-padded; ``last_idx`` selects the last REAL token's logits,
+    and causal masking keeps the pad positions (which sit after it) out
+    of that row entirely.
+    """
+    logits, cache = forward_cached(
+        params, cfg, tokens, cache, moe_decode=moe_decode, mesh=None,
+        all_logits=True)
+    last = jax.lax.dynamic_index_in_dim(
+        logits, last_idx, axis=1, keepdims=False)
+    return last, cache
+
+
+@dataclasses.dataclass
+class _PrefillState:
+    """Host-side cursor of one in-flight chunked prefill: the [1,
+    max_len] temp cache being filled and how many prompt tokens have
+    streamed through it so far."""
+
+    cache: KVCache
+    pos: int = 0
+
+
 class ServeEngine:
     """Continuous-batching server over a model + paged KV pool.
 
@@ -171,15 +238,32 @@ class ServeEngine:
                  sample: SampleConfig | None = None,
                  admission: str = "reserve",
                  moe_decode: str = "dense",
+                 attention_impl: str = "paged",
+                 prefill_chunk: int | None = 32,
+                 prefill_chunks_per_step: int = 1,
                  mesh=None,
                  rng: jax.Array | None = None,
                  journal: Any = None):
+        if attention_impl not in ("paged", "dense"):
+            raise ValueError(
+                f"unknown attention_impl {attention_impl!r} "
+                f"(expected 'paged' or 'dense')")
         self.cfg: TransformerConfig = model.cfg
         self.params = variables["params"]
         self.sample = sample or SampleConfig(temperature=0.0)
         self.n_slots = n_slots
         self.max_len = max_len
         self.moe_decode = moe_decode
+        self.attention_impl = attention_impl
+        if prefill_chunk is not None:
+            # snap the chunk to a divisor of max_len: the temp cache is
+            # exactly [1, max_len], so the cursor can never run past it
+            # (a learned-pos dynamic_slice would clamp its start and
+            # silently corrupt the chunk's position embeddings)
+            prefill_chunk = math.gcd(
+                min(int(prefill_chunk), max_len), max_len)
+        self.prefill_chunk = prefill_chunk
+        self.prefill_chunks_per_step = max(1, int(prefill_chunks_per_step))
         self.mesh = mesh
         self.max_blocks = blocks_for_tokens(max_len, block_size)
         if num_blocks is None:
@@ -196,10 +280,21 @@ class ServeEngine:
         self._step_count = 0
         self._occupancy_sum = 0.0
         self.finished: list[Request] = []
+        self._prefill: dict[int, _PrefillState] = {}
         self._step_fn = jax.jit(
             partial(_paged_decode_step, cfg=self.cfg, sample=self.sample,
-                    moe_decode=moe_decode, mesh=mesh, spec=self.pool.spec),
+                    moe_decode=moe_decode, attention_impl=attention_impl,
+                    mesh=mesh, spec=self.pool.spec),
             donate_argnums=(1,))
+        self._prefill_fn = jax.jit(
+            partial(_prefill_chunk_step, cfg=self.cfg,
+                    moe_decode=moe_decode))
+        if self.journal is not None:
+            self.journal.event(
+                "serve.engine", attention_impl=attention_impl,
+                prefill_chunk=self.prefill_chunk,
+                n_slots=n_slots, max_len=max_len, block_size=block_size,
+                quant_kv=bool(quant_kv))
 
     # -- request intake ------------------------------------------------------
 
@@ -246,6 +341,53 @@ class ServeEngine:
         req.out_tokens = [first]
         req.t_first_token = time.monotonic()
 
+    def _start_prefill(self, slot: int, req: Request) -> None:
+        """Admission entry point: legacy single-shot prefill, or flip
+        the slot to "prefilling" so step() streams the prompt through
+        the shared chunk trace, interleaved with decode."""
+        if self.prefill_chunk is None:
+            self._prefill_into_slot(slot, req)
+            return
+        req.state = "prefilling"
+        self._prefill[req.rid] = _PrefillState(
+            cache=KVCache.init(self.cfg, 1, self.max_len,
+                               dtype=jnp.bfloat16))
+
+    def _advance_prefill(self, slot: int, req: Request) -> None:
+        """One [1, C] chunk of ``req``'s prompt.  On the final chunk:
+        sample the first token (identical rng derivation to single-shot
+        prefill), copy the filled temp-cache rows into the request's
+        blocks, and hand the slot to decode."""
+        st = self._prefill[req.rid]
+        C = self.prefill_chunk
+        chunk = req.prompt[st.pos:st.pos + C]
+        n_real = len(chunk)
+        tokens = jnp.asarray(chunk + [0] * (C - n_real), jnp.int32)[None]
+        t0 = time.monotonic()
+        logits, st.cache = self._prefill_fn(
+            self.params, tokens, st.cache, n_real - 1)
+        st.pos += n_real
+        done = st.pos >= req.n_prompt
+        if done:
+            req_rng = jax.random.fold_in(self._rng, req.rid)
+            _, first_rng = jax.random.split(req_rng)
+            first = int(jax.device_get(
+                _sample(logits, first_rng, self.sample))[0])
+            self.pool.write_prefill(
+                req.blocks[:blocks_for_tokens(
+                    req.n_prompt, self.pool.block_size)],
+                st.cache.k[:, 0, :req.n_prompt],
+                st.cache.v[:, 0, :req.n_prompt])
+            req.out_tokens = [first]
+            req.t_first_token = time.monotonic()
+            req.state = "running"
+            del self._prefill[req.rid]
+        if self.journal is not None:
+            self.journal.event(
+                "serve.prefill_chunk", rid=req.rid, slot=slot,
+                pos=min(st.pos, req.n_prompt), n_tokens=n_real,
+                seconds=time.monotonic() - t0, done=done)
+
     def _decode_all(self) -> None:
         S, MB = self.n_slots, self.max_blocks
         tables = np.zeros((S, MB), np.int32)
@@ -253,7 +395,10 @@ class ServeEngine:
         last = np.zeros((S,), np.int32)
         act = np.zeros((S,), bool)
         for s, req in enumerate(self.scheduler.slots):
-            if req is None:
+            if req is None or req.state != "running":
+                # prefilling slots keep an all-null table here: the
+                # step's unconditional KV write lands in the scratch
+                # block instead of their half-filled prompt blocks
                 continue
             tables[s, :len(req.blocks)] = req.blocks
             # this step writes token n_generated at absolute position
@@ -292,31 +437,48 @@ class ServeEngine:
             preempted=req.preempted)
 
     def step(self) -> None:
-        """One serving iteration: evict finished, admit+prefill queued,
-        grow/preempt (optimistic), decode every active slot."""
+        """One serving iteration: evict finished, admit queued, advance
+        prefill chunks, grow/preempt (optimistic), decode every
+        decoding slot.  Prefill chunks INTERLEAVE with decode steps —
+        a long prompt costs each iteration one bounded chunk instead of
+        stalling the whole batch for its full prefill."""
         sched = self.scheduler
         for s in range(self.n_slots):
             req = sched.slots[s]
-            if req is not None and req.finished():
+            if (req is not None and req.state == "running"
+                    and req.finished()):
                 self._finish(s)
         for slot, req in sched.admit():
-            self._prefill_into_slot(slot, req)
-            if req.finished():  # max_new_tokens == 1
-                self._finish(slot)
+            self._start_prefill(slot, req)
+            if req.state == "running" and req.finished():
+                self._finish(slot)  # single-shot, max_new_tokens == 1
+        prefill_s = 0.0
+        for slot, req in sched.prefill_plan(self.prefill_chunks_per_step):
+            t0 = time.monotonic()
+            self._advance_prefill(slot, req)
+            prefill_s += time.monotonic() - t0
+            if req.state == "running" and req.finished():
+                self._finish(slot)  # chunked, max_new_tokens == 1
         for victim in sched.grow_for_step():
+            self._prefill.pop(victim.rid, None)
             if self.journal is not None:
                 self.journal.event("serve.preempt", rid=victim.rid,
                                    n_regenerate=victim.n_prompt)
-        if sched.n_active:
+        decode_s = 0.0
+        if sched.n_decoding:
+            t0 = time.monotonic()
             self._decode_all()
+            decode_s = time.monotonic() - t0
         self._step_count += 1
         self._occupancy_sum += sched.n_active / self.n_slots
         if self.journal is not None:
             self.journal.event(
                 "serve.step", step=self._step_count,
                 n_active=sched.n_active, n_queued=sched.n_queued,
+                n_prefilling=sched.n_prefilling,
                 occupancy=sched.n_active / self.n_slots,
-                free_blocks=self.pool.allocator.n_free)
+                free_blocks=self.pool.allocator.n_free,
+                prefill_s=prefill_s, decode_s=decode_s)
 
     @property
     def mean_occupancy(self) -> float | None:

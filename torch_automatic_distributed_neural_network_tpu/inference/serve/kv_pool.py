@@ -46,7 +46,7 @@ import jax.numpy as jnp
 
 from ...models.transformer_core import TransformerConfig
 from ..decode import cache_partition_spec
-from ..quant import is_quantized_leaf, quantize_kv
+from ..quant import is_quantized_leaf, kv_leaf_parts, quantize_kv
 
 NULL_BLOCK = 0  # reserved scratch target for inactive-slot writes
 
@@ -130,7 +130,7 @@ def _zeros_side(shape, dtype, quantize: bool):
 
 def gather_blocks(kv_layer: Any, table: jax.Array,
                   dtype=jnp.bfloat16) -> jax.Array:
-    """Dense per-slot view of one layer's paged KV.
+    """Dense per-slot view of one layer's paged KV — the REFERENCE path.
 
     ``kv_layer``: [NB, bs, kvH, hd] (or its ``{"q","scale"}`` int8
     form); ``table``: [S, max_blocks] int32 —> [S, max_blocks*bs, kvH,
@@ -138,13 +138,23 @@ def gather_blocks(kv_layer: Any, table: jax.Array,
     gathered from those pages sits beyond each slot's context length
     and the attention mask never admits it.  Dequantize-on-gather keeps
     the int8 arrays as what lives in HBM (same contract as the weight
-    path) — only the gathered working set converts.
+    path) — only the gathered working set converts; an fp pool skips
+    the dequantize pass entirely (no per-element convert when the pool
+    already stores ``dtype``).
+
+    This materialized view is what the fused kernel
+    (ops/paged_attention.py) exists to eliminate; it stays as the
+    engine's ``attention_impl="dense"`` path and as the oracle every
+    kernel parity test compares against.
     """
-    if is_quantized_leaf(kv_layer):
-        g = (kv_layer["q"][table].astype(jnp.float32)
-             * kv_layer["scale"][table]).astype(dtype)
+    payload, scale = kv_leaf_parts(kv_layer)
+    if scale is not None:
+        g = (payload[table].astype(jnp.float32)
+             * scale[table]).astype(dtype)
     else:
-        g = kv_layer[table].astype(dtype)
+        g = payload[table]
+        if g.dtype != dtype:
+            g = g.astype(dtype)
     S, MB, bs, H, hd = g.shape
     return g.reshape(S, MB * bs, H, hd)
 
@@ -159,8 +169,7 @@ def write_token(kv_layer: Any, table: jax.Array, pos: jax.Array,
     in the scratch block.  int8 mode quantizes the token in place with
     its own per-head scale.
     """
-    bs = (kv_layer["q"] if is_quantized_leaf(kv_layer)
-          else kv_layer).shape[1]
+    bs = kv_leaf_parts(kv_layer)[0].shape[1]
     S = table.shape[0]
     blk = jnp.take_along_axis(
         table, (pos // bs)[:, None].astype(jnp.int32), axis=1)[:, 0]

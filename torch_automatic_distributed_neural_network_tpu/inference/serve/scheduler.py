@@ -54,7 +54,10 @@ class Request:
         default_factory=lambda: next(_rid_counter))
     eos_id: int | None = None
 
-    # lifecycle: queued -> running -> done (preemption loops back)
+    # lifecycle: queued -> [prefilling ->] running -> done (preemption
+    # loops back to queued; "prefilling" only under the engine's
+    # chunked-prefill mode, where a slot streams its prompt across
+    # steps before joining decode)
     state: str = "queued"
     slot: int | None = None
     blocks: list[int] = dataclasses.field(default_factory=list)
@@ -111,6 +114,17 @@ class Scheduler:
     @property
     def n_queued(self) -> int:
         return len(self.queue)
+
+    @property
+    def n_decoding(self) -> int:
+        """Slots actively decoding (excludes chunked-prefill slots)."""
+        return sum(r is not None and r.state == "running"
+                   for r in self.slots)
+
+    @property
+    def n_prefilling(self) -> int:
+        return sum(r is not None and r.state == "prefilling"
+                   for r in self.slots)
 
     def idle(self) -> bool:
         return self.n_active == 0 and not self.queue
@@ -173,6 +187,17 @@ class Scheduler:
             admitted.append((slot, req))
         return admitted
 
+    def prefill_plan(self, max_chunks: int) -> list[tuple[int, Request]]:
+        """The prefilling slots due a chunk this step: FIFO by
+        admission time, at most ``max_chunks`` of them.  The engine
+        advances each returned slot by exactly one chunk, so this cap
+        bounds how much prefill work can delay a step's decode."""
+        due = [(r.t_admit or 0.0, r.slot, r)
+               for r in self.slots
+               if r is not None and r.state == "prefilling"]
+        due.sort(key=lambda t: (t[0], t[1]))
+        return [(slot, r) for _, slot, r in due[:max_chunks]]
+
     def evict(self, slot: int) -> Request:
         """Finished request out of its slot; blocks back to the pool."""
         req = self.slots[slot]
@@ -218,7 +243,9 @@ class Scheduler:
         for slot in range(self.n_slots):
             while True:
                 req = self.slots[slot]
-                if req is None:
+                if req is None or req.state != "running":
+                    # prefilling slots own their prompt blocks already
+                    # and take no decode write this step
                     break
                 # this step writes KV at absolute position
                 # n_prompt + n_generated - 1 (the first generated token

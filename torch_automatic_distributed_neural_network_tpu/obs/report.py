@@ -286,6 +286,8 @@ def generate(target: str, metrics_path: str | None = None) -> dict:
     sreqs = [e for e in events if e.get("name") == "serve.request"]
     ssteps = [e for e in events if e.get("name") == "serve.step"]
     spreempt = [e for e in events if e.get("name") == "serve.preempt"]
+    sengine = last("serve.engine")
+    schunks = [e for e in events if e.get("name") == "serve.prefill_chunk"]
     if sreqs or ssteps:
         totals = sorted(_finite(e.get("total_s") for e in sreqs))
 
@@ -315,6 +317,17 @@ def generate(target: str, metrics_path: str | None = None) -> dict:
             "preemptions": (len(spreempt)
                             or sum(int(e.get("preempted") or 0)
                                    for e in sreqs)),
+            # per-step phase breakdown (engines that journal the r02
+            # fields; absent keys drop out below)
+            "mean_decode_step_s": _mean(e.get("decode_s")
+                                        for e in ssteps),
+            "mean_prefill_step_s": _mean(e.get("prefill_s")
+                                         for e in ssteps),
+            "n_prefill_chunks": len(schunks) or None,
+            "mean_prefill_chunk_s": _mean(e.get("seconds")
+                                          for e in schunks),
+            "attention_impl": (sengine or {}).get("attention_impl"),
+            "prefill_chunk": (sengine or {}).get("prefill_chunk"),
         }
         report["serving"] = {k: v for k, v in serving.items()
                              if v is not None}
@@ -359,7 +372,8 @@ def generate(target: str, metrics_path: str | None = None) -> dict:
             for k in ("max_streams", "requested_streams", "num_blocks",
                       "blocks_per_stream", "block_size", "max_len",
                       "quant_kv", "budget_bytes",
-                      "block_bytes_per_device")
+                      "block_bytes_per_device", "attention_impl",
+                      "decode_workspace_bytes")
             if sest.get(k) is not None}
     if metrics_path and os.path.isfile(metrics_path):
         recs = _read_metrics(metrics_path)
@@ -596,6 +610,20 @@ def format_report(report: dict) -> str:
                 f"per-request {sv['mean_tokens_per_s']:.1f} tok/s")
         parts.append(f"{sv.get('preemptions', 0)} preemption(s)")
         lines.append("  " + "  ".join(parts))
+        bparts = []
+        if sv.get("attention_impl"):
+            bparts.append(f"decode impl {sv['attention_impl']}")
+        if sv.get("mean_decode_step_s") is not None:
+            bparts.append(
+                f"decode step {sv['mean_decode_step_s'] * 1e3:.1f}ms")
+        if sv.get("mean_prefill_chunk_s") is not None:
+            bparts.append(
+                f"prefill chunk {sv['mean_prefill_chunk_s'] * 1e3:.1f}ms"
+                f" x{sv.get('n_prefill_chunks', 0)}"
+                + (f" (C={sv['prefill_chunk']})"
+                   if sv.get("prefill_chunk") else ""))
+        if bparts:
+            lines.append("  " + "  ".join(bparts))
     sest = report.get("serve_estimate")
     if sest:
         head = (f"serve estimate: {sest.get('max_streams')} stream(s) "
@@ -605,6 +633,11 @@ def format_report(report: dict) -> str:
                 f"{', int8 KV' if sest.get('quant_kv') else ''})")
         if sest.get("requested_streams") is not None:
             head += f", requested {sest['requested_streams']}"
+        if sest.get("attention_impl"):
+            head += f", {sest['attention_impl']} decode"
+        if sest.get("decode_workspace_bytes"):
+            head += (f" (+{sest['decode_workspace_bytes'] // 1024} KiB "
+                     f"gather workspace)")
         lines.append(head)
     lint = report.get("lint")
     if lint:

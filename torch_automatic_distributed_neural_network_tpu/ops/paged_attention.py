@@ -1,0 +1,247 @@
+"""Pallas paged-attention decode kernel: block tables read in-kernel.
+
+The serving decode step used to gather every slot's KV blocks into a
+dense ``[S, max_len, kvH, hd]`` view (``kv_pool.gather_blocks``) before
+stock attention — an O(slots x max_len) HBM materialization per layer
+per token, the exact cost ROADMAP's "Serving path, phase 2" calls out.
+This kernel eliminates it: the per-request block table is a
+scalar-prefetch operand, so each grid step's ``index_map`` reads
+``table[slot, j]`` and DMAs block ``j``'s page straight from the paged
+pool into VMEM.  No dense view ever exists; HBM traffic is O(tokens
+actually cached), the same bytes the pool stores.
+
+Shape of the problem (one decode token per slot):
+
+    q:      [S, Hq, hd]          one query per slot
+    k/v:    [NB, bs, kvH, hd]    ONE layer of the paged pool
+    tables: [S, MB] int32        block ids, null-padded (kv_pool)
+    ctx:    [S] int32            keys 0..ctx inclusive are valid
+
+Grid is ``(S, kvH, MB)`` with the block axis innermost ("arbitrary"
+semantics): VMEM scratch carries flash-style online-softmax statistics
+(running max / sum / accumulator, fp32) across a slot's blocks, exactly
+the ``ops/flash_attention.py`` discipline.  GQA is native — each kv
+head serves its ``Hq // kvH`` query group without materializing the
+head broadcast.  Blocks past a slot's context (null-table padding) are
+skipped at the grid level via the prefetched ``ctx``; a sliding window
+additionally skips blocks entirely older than ``ctx - window``.
+
+int8 KV (``inference/quant.quantize_kv``'s ``{"q", "scale"}`` leaves)
+is dequantized ON LOAD, fused into the kernel: the int8 payload and its
+per-(token, head) fp32 scales stream into VMEM and the multiply happens
+right before the MXU dot — the dense bf16 form of a block never touches
+HBM either.
+
+CPU fallback follows ``flash_attention.py``: ``interpret=True`` (the
+default off-TPU) runs the same kernel in the Pallas interpreter, so the
+CPU-sim tests exercise the real kernel logic;
+:func:`paged_attention_reference` is the pure-JAX oracle — it IS the
+dense ``gather_blocks`` + ``xla_attention`` path the engine's
+``attention_impl="dense"`` runs, which is what makes paged-vs-dense
+parity a one-assert test.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_BIG = -0.7 * float(np.finfo(np.float32).max)
+_LANES = 128  # row stats stored lane-broadcast, as in flash_attention
+
+
+def _default_interpret() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+@dataclasses.dataclass(frozen=True)
+class _Cfg:
+    block_size: int
+    group: int  # query heads per kv head (Hq // kvH)
+    window: int | None
+    quantized: bool
+    interpret: bool
+
+
+def _decode_kernel(*refs, cfg: _Cfg, scale: float):
+    """One (slot, kv_head, block) grid step of paged decode attention."""
+    tables_ref, ctx_ref = refs[0], refs[1]
+    if cfg.quantized:
+        (q_ref, kq_ref, ks_ref, vq_ref, vs_ref,
+         o_ref, acc_ref, m_ref, l_ref) = refs[2:]
+    else:
+        q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs[2:]
+
+    s = pl.program_id(0)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_BIG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    ctx = ctx_ref[s]
+    start = j * cfg.block_size
+    # a block is relevant iff it holds any key <= ctx (and, windowed,
+    # any key newer than ctx - window) — the table's null padding sits
+    # past ctx by construction, so padding blocks are skipped here
+    relevant = start <= ctx
+    if cfg.window is not None:
+        relevant = jnp.logical_and(
+            relevant, start + cfg.block_size - 1 > ctx - cfg.window)
+
+    @pl.when(relevant)
+    def _block():
+        q = q_ref[0, 0].astype(jnp.float32)  # [G, hd]
+        if cfg.quantized:
+            # dequantize-on-load: int8 payload x per-(token, head) scale,
+            # fused right before the dot — the dense form never hits HBM
+            k = kq_ref[0, :, 0].astype(jnp.float32) * ks_ref[0, :, 0]
+            v = vq_ref[0, :, 0].astype(jnp.float32) * vs_ref[0, :, 0]
+        else:
+            k = k_ref[0, :, 0].astype(jnp.float32)  # [bs, hd]
+            v = v_ref[0, :, 0].astype(jnp.float32)
+        sc = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [G, bs]
+        pos = start + jax.lax.broadcasted_iota(jnp.int32, sc.shape, 1)
+        valid = pos <= ctx
+        if cfg.window is not None:
+            valid = jnp.logical_and(valid, pos > ctx - cfg.window)
+        sc = jnp.where(valid, sc, _NEG_BIG)
+
+        m_prev = m_ref[:, :1]  # [G, 1] (lane-broadcast storage)
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(sc, axis=-1, keepdims=True))
+        m_new = jnp.maximum(m_new, _NEG_BIG / 2)
+        p = jnp.exp(sc - m_new)  # [G, bs] fp32
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [G, hd]
+        acc_ref[:] = acc_ref[:] * alpha + pv
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        l_safe = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+
+
+def paged_attention(
+    q: jax.Array,
+    k_pool,
+    v_pool,
+    tables: jax.Array,
+    ctx_lens: jax.Array,
+    *,
+    window: int | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Fused paged decode attention over one layer of the KV pool.
+
+    ``q``: [S, Hq, hd] (one decode token per slot); ``k_pool``/``v_pool``:
+    [NB, bs, kvH, hd] or the ``{"q": int8, "scale": fp32}`` quantized
+    leaf; ``tables``: [S, MB] int32 null-padded block tables; ``ctx_lens``:
+    [S] int32, keys ``0..ctx`` inclusive are attendable (the engine's
+    decode-step convention: this step's key was just written at ``ctx``).
+    Returns [S, Hq, hd] in ``q.dtype``.  The dense gathered view is never
+    materialized — block pages stream VMEM-ward via the table prefetch.
+    """
+    from ..inference.quant import kv_leaf_parts
+
+    if interpret is None:
+        interpret = _default_interpret()
+    k_arr, k_scale = kv_leaf_parts(k_pool)
+    v_arr, v_scale = kv_leaf_parts(v_pool)
+    quantized = k_scale is not None
+    S, Hq, hd = q.shape
+    NB, bs, kvH, _ = k_arr.shape
+    MB = tables.shape[1]
+    if Hq % kvH:
+        raise ValueError(f"{Hq} query heads not a multiple of "
+                         f"{kvH} kv heads")
+    G = Hq // kvH
+    cfg = _Cfg(block_size=bs, group=G, window=window,
+               quantized=quantized, interpret=interpret)
+    qg = q.reshape(S, kvH, G, hd)
+
+    q_spec = pl.BlockSpec((1, 1, G, hd), lambda s, h, j, t, c: (s, h, 0, 0))
+    # the table read: grid step (s, h, j) DMAs pool block table[s, j]
+    kv_spec = pl.BlockSpec(
+        (1, bs, 1, hd), lambda s, h, j, t, c: (t[s, j], 0, h, 0))
+    scale_spec = pl.BlockSpec(
+        (1, bs, 1, 1), lambda s, h, j, t, c: (t[s, j], 0, h, 0))
+    if quantized:
+        in_specs = [q_spec, kv_spec, scale_spec, kv_spec, scale_spec]
+        operands = (qg, k_arr, k_scale, v_arr, v_scale)
+    else:
+        in_specs = [q_spec, kv_spec, kv_spec]
+        operands = (qg, k_arr, v_arr)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, kvH, MB),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, 1, G, hd), lambda s, h, j, t, c: (s, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((G, hd), jnp.float32),
+            pltpu.VMEM((G, _LANES), jnp.float32),
+            pltpu.VMEM((G, _LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, cfg=cfg,
+                          scale=1.0 / float(np.sqrt(hd))),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, kvH, G, hd), q.dtype),
+        interpret=interpret,
+    )(tables.astype(jnp.int32), ctx_lens.astype(jnp.int32), *operands)
+    return out.reshape(S, Hq, hd)
+
+
+def paged_attention_reference(
+    q: jax.Array,
+    k_pool,
+    v_pool,
+    tables: jax.Array,
+    ctx_lens: jax.Array,
+    *,
+    window: int | None = None,
+    dtype=None,
+) -> jax.Array:
+    """Pure-JAX oracle: the dense decode path, verbatim.
+
+    Gathers the block table into the dense view with
+    ``kv_pool.gather_blocks`` (the engine's ``attention_impl="dense"``
+    reference path) and runs ``xla_attention`` under the same
+    ctx/window mask the engine builds — so kernel-vs-reference parity
+    IS paged-vs-dense parity.
+    """
+    from ..inference.serve.kv_pool import gather_blocks
+    from .attention import xla_attention
+
+    if dtype is None:
+        dtype = q.dtype
+    kd = gather_blocks(k_pool, tables, dtype)
+    vd = gather_blocks(v_pool, tables, dtype)
+    key_idx = jnp.arange(kd.shape[1])[None, :]
+    mask = key_idx <= ctx_lens[:, None]
+    if window is not None:
+        mask &= key_idx > ctx_lens[:, None] - window
+    o = xla_attention(q[:, None], kd, vd, causal=False,
+                      mask=mask[:, None, None, :])
+    return o[:, 0]
