@@ -28,6 +28,13 @@ Usage (all key=value, bench.py-style):
     python bench_serve.py [streams=8] [slots=4] [prompt_len=12]
         [max_new=16] [block_size=8] [quant_kv=0] [seed=0]
         [attention_impl=paged|dense] [prefill_chunk=32]
+        [adapters=0] [adapter_rank=8] [quant_adapters=0] [speculative=0]
+
+r03 adds the multi-tenant knobs: ``adapters=N`` registers N random
+rank-``adapter_rank`` LoRA tenants in the engine's paged adapter pool
+(one jitted trace for all of them) and round-robins streams over them;
+``speculative=K`` turns on K-token n-gram draft-and-verify decode.
+``extra`` then records the adapter mix and the measured accept rate.
 
 r02 adds a per-step component breakdown (``extra["breakdown"]``):
 gather / attention / scatter milliseconds per decode step measured by
@@ -59,6 +66,8 @@ def parse_args():
         "streams": 8, "slots": 4, "prompt_len": 12, "max_new": 16,
         "block_size": 8, "max_len": 64, "quant_kv": 0, "seed": 0,
         "vocab": 128, "attention_impl": "paged", "prefill_chunk": 32,
+        "adapters": 0, "adapter_rank": 8, "quant_adapters": 0,
+        "speculative": 0,
     }
     for item in sys.argv[1:]:
         k, _, v = item.partition("=")
@@ -201,6 +210,13 @@ def run_load(args, journal) -> dict:
 
     impl = str(args["attention_impl"])
     chunk = int(args["prefill_chunk"]) or None  # 0 -> single-shot
+    n_adapters = int(args["adapters"])
+    lora_spec = None
+    if n_adapters:
+        from torch_automatic_distributed_neural_network_tpu.training \
+            .lora import LoraSpec
+
+        lora_spec = LoraSpec(rank=int(args["adapter_rank"]))
     eng = ServeEngine(
         model, variables,
         n_slots=int(args["slots"]),
@@ -209,13 +225,28 @@ def run_load(args, journal) -> dict:
         quant_kv=bool(int(args["quant_kv"])),
         attention_impl=impl,
         prefill_chunk=chunk,
+        lora_spec=lora_spec,
+        n_adapters=n_adapters + 1 if n_adapters else 8,
+        quant_adapters=bool(int(args["quant_adapters"])),
+        speculative=int(args["speculative"]),
         journal=journal,
     )
-    for _ in range(int(args["streams"])):
+    if n_adapters:
+        from torch_automatic_distributed_neural_network_tpu.inference \
+            .serve import random_adapter
+
+        for i in range(n_adapters):
+            eng.register_adapter(
+                f"tenant{i}",
+                random_adapter(variables["params"], lora_spec,
+                               seed=int(args["seed"]) + 100 + i))
+    for j in range(int(args["streams"])):
         prompt = rs.randint(1, int(args["vocab"]),
                             size=(int(args["prompt_len"]),))
         eng.submit([int(t) for t in prompt],
-                   max_new_tokens=int(args["max_new"]), eos_id=0)
+                   max_new_tokens=int(args["max_new"]), eos_id=0,
+                   adapter=(f"tenant{j % n_adapters}"
+                            if n_adapters else None))
     # warm the decode-step executable outside the timed window: the
     # first step pays trace+compile, which is not a serving number
     eng.step()
@@ -274,6 +305,18 @@ def run_load(args, journal) -> dict:
                                if eng.mean_occupancy is not None
                                else None),
             "preemptions": eng.scheduler.n_preemptions,
+            "n_adapters": n_adapters,
+            "adapter_rank": (int(args["adapter_rank"])
+                             if n_adapters else None),
+            "quant_adapters": bool(int(args["quant_adapters"])
+                                   and n_adapters),
+            "adapter_hit_rate": (
+                round(eng.adapter_pool.allocator.hit_rate, 4)
+                if eng.adapter_pool is not None else None),
+            "speculative": int(args["speculative"]),
+            "spec_accept_rate": (
+                round(eng.spec_accepted / eng.spec_drafted, 4)
+                if eng.spec_drafted else None),
             "device_kind": device_kind,
             "backend": jax.default_backend(),
         },

@@ -1,0 +1,574 @@
+"""Multi-tenant serving tests: the paged LoRA adapter pool
+(inference/serve/adapters.py), adapter pins through the scheduler,
+speculative decode, and their telemetry/lint surfaces.
+
+Fast tests are host-only allocator/scheduler/report/lint checks
+(tier-1); the engine parity tests — batched multi-adapter decode vs the
+merge_lora+generate() oracle, speculative vs plain greedy — run on the
+8-device CPU sim and are marked slow.  Every engine test also asserts
+the ONE-trace contract: ``_cache_size() == 1`` after serving
+heterogeneous tenants.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torch_automatic_distributed_neural_network_tpu.analysis.serve_lint import (
+    serve_estimate,
+)
+from torch_automatic_distributed_neural_network_tpu.inference import generate
+from torch_automatic_distributed_neural_network_tpu.inference.serve import (
+    IDENTITY_ADAPTER,
+    AdapterAllocator,
+    AdapterPool,
+    BlockAllocator,
+    Request,
+    Scheduler,
+    ServeEngine,
+    pool_adapter_bytes,
+    random_adapter,
+)
+from torch_automatic_distributed_neural_network_tpu.inference.speculative import (
+    accept_length,
+    ngram_propose,
+)
+from torch_automatic_distributed_neural_network_tpu.models import GPT2
+from torch_automatic_distributed_neural_network_tpu.obs import (
+    report as obs_report,
+)
+from torch_automatic_distributed_neural_network_tpu.training.lora import (
+    MLP_LIKE,
+    LoraSpec,
+    merge_lora,
+)
+
+VOCAB = 128
+
+
+def _model_and_vars(seed=1, p=12):
+    model = GPT2("test", vocab_size=VOCAB, max_seq_len=64,
+                 dtype=jnp.float32, remat=False)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(1, VOCAB, size=(1, p)), jnp.int32)
+    return model, model.init(jax.random.key(seed), tokens)
+
+
+def _merged_vars(variables, lora, spec):
+    out = dict(variables)
+    out["params"] = merge_lora(variables["params"], lora, spec)
+    return out
+
+
+# -- adapter slot allocator ---------------------------------------------------
+
+
+def test_adapter_allocator_lru_pins_and_eviction():
+    a = AdapterAllocator(4)  # slots 1..3 for tenants, 0 = identity
+    s1, res1, ev1 = a.acquire("t1")
+    s2, _, _ = a.acquire("t2")
+    s3, _, _ = a.acquire("t3")
+    assert {s1, s2, s3} == {1, 2, 3} and not res1 and ev1 is None
+    assert a.acquire("t4") is None  # everything pinned: no eviction
+    a.release("t1")
+    s4, res4, ev4 = a.acquire("t4")  # evicts the LRU unpinned (t1)
+    assert s4 == s1 and not res4 and ev4 == "t1"
+    assert a.evictions == 1
+    # released residents stay warm: re-acquire is a hit
+    a.release("t2")
+    s2b, res2b, _ = a.acquire("t2")
+    assert s2b == s2 and res2b
+    assert a.hits == 1 and a.faults == 4
+    assert a.hit_rate == pytest.approx(1 / 5)
+
+
+def test_adapter_allocator_loud_release_and_invalidate():
+    a = AdapterAllocator(3)
+    a.acquire("x")
+    with pytest.raises(ValueError, match="no pinned reference"):
+        a.release("never-acquired")
+    with pytest.raises(ValueError, match="pinned"):
+        a.invalidate("x")  # live decode reads those factors
+    a.release("x")
+    with pytest.raises(ValueError, match="no pinned reference"):
+        a.release("x")  # double release is loud
+    a.invalidate("x")  # unpinned resident may be dropped
+    assert a.slot_of("x") is None and a.n_resident == 0
+    a.invalidate("x")  # idempotent once gone
+
+
+def test_adapter_allocator_churn_no_leak():
+    """500 random acquire/release/invalidate rounds: refcounts, the LRU
+    order, and the free list stay mutually consistent (the kv-pool
+    churn test one level up)."""
+    rs = np.random.RandomState(11)
+    cap = 5  # tenant slots in an n_adapters=6 pool
+    a = AdapterAllocator(cap + 1)
+    pins: dict[str, int] = {}
+    names = [f"t{i}" for i in range(9)]
+    for _ in range(500):
+        roll = rs.rand()
+        name = names[rs.randint(len(names))]
+        if roll < 0.5:
+            got = a.acquire(name)
+            if got is None:
+                assert a.n_pinned == cap  # only full pinnage refuses
+            else:
+                pins[name] = pins.get(name, 0) + 1
+        elif roll < 0.9:
+            pinned = [n for n, c in pins.items() if c > 0]
+            if pinned:
+                victim = pinned[rs.randint(len(pinned))]
+                a.release(victim)
+                pins[victim] -= 1
+        else:
+            unpinned_resident = [
+                n for n in names
+                if a.slot_of(n) is not None and not pins.get(n)]
+            if unpinned_resident:
+                a.invalidate(
+                    unpinned_resident[rs.randint(len(unpinned_resident))])
+        assert a.pinned_names() == {n: c for n, c in pins.items() if c}
+        assert a.n_resident + len(a._free) == cap
+        assert a.n_resident == len(a._order)
+    for n, c in pins.items():
+        for _ in range(c):
+            a.release(n)
+    assert a.n_pinned == 0
+
+
+# -- pool registration --------------------------------------------------------
+
+
+def test_pool_register_validates_sites_and_shapes():
+    model, variables = _model_and_vars()
+    spec = LoraSpec(rank=4)
+    pool = AdapterPool(variables["params"], spec, n_adapters=3)
+    good = random_adapter(variables["params"], spec, seed=3)
+    pool.register("ok", good)
+    assert pool.has("ok") and pool.names == ("ok",)
+
+    wrong_rank = random_adapter(variables["params"], LoraSpec(rank=2),
+                                seed=3)
+    with pytest.raises(ValueError, match="do not match the pool"):
+        pool.register("bad-rank", wrong_rank)
+
+    partial = {"layers": {"attn": {"q_proj": {
+        "kernel": jax.tree.map(lambda x: x, good["layers"]["attn"]
+                               ["q_proj"]["kernel"])}}}}
+    with pytest.raises(ValueError, match="do not match the pool"):
+        pool.register("missing-v", partial)
+
+    with pytest.raises(NotImplementedError, match="attention projections"):
+        AdapterPool(variables["params"], LoraSpec(targets=(MLP_LIKE,)))
+
+
+def test_pool_register_while_pinned_refuses_then_reloads():
+    model, variables = _model_and_vars()
+    spec = LoraSpec(rank=4)
+    pool = AdapterPool(variables["params"], spec, n_adapters=3)
+    pool.register("t0", random_adapter(variables["params"], spec, seed=1))
+    slot, was_res, _ = pool.acquire("t0")
+    assert slot != IDENTITY_ADAPTER and not was_res
+    with pytest.raises(ValueError, match="pinned"):
+        pool.register("t0", random_adapter(variables["params"], spec,
+                                           seed=2))
+    pool.release("t0")
+    pool.register("t0", random_adapter(variables["params"], spec, seed=2))
+    # the stale resident copy was invalidated: next acquire re-faults
+    slot2, was_res2, _ = pool.acquire("t0")
+    assert not was_res2
+    pool.release("t0")
+
+
+def test_pool_int8_identity_slot_is_exact_zero():
+    model, variables = _model_and_vars()
+    spec = LoraSpec(rank=4)
+    pool = AdapterPool(variables["params"], spec, n_adapters=3,
+                       quantize=True)
+    for key, fac in pool.factors.items():
+        for side in ("a", "b"):
+            assert set(fac[side]) == {"q", "scale"}
+            q0 = np.asarray(fac[side]["q"][:, IDENTITY_ADAPTER])
+            assert not q0.any()  # dequantizes to exactly 0
+
+
+def test_pool_adapter_bytes_arithmetic():
+    cfg = GPT2("test", vocab_size=VOCAB, max_seq_len=64,
+               dtype=jnp.float32, remat=False).cfg
+    r, n = 8, 5
+    fp = pool_adapter_bytes(cfg, rank=r, n_adapters=n)
+    q_out = cfg.n_heads * cfg.head_dim
+    v_out = cfg.kv_heads * cfg.head_dim
+    per_layer = sum(4 * (cfg.d_model * r + r * d) for d in (q_out, v_out))
+    assert fp == cfg.n_layers * n * per_layer
+    q8 = pool_adapter_bytes(cfg, rank=r, n_adapters=n, quantize=True)
+    assert q8 < fp // 3  # int8 payload + small fp32 scales
+
+
+# -- n-gram drafting ----------------------------------------------------------
+
+
+def test_ngram_propose_replays_longest_match():
+    # trailing (7, 8) occurred before, followed by 9, 1 -> replay them
+    hist = [5, 7, 8, 9, 1, 7, 8]
+    assert ngram_propose(hist, 2) == [9, 1]
+    # no earlier occurrence of any trailing n-gram: pad with last token
+    assert ngram_propose([1, 2, 3], 3) == [3, 3, 3]
+    # always exactly k long even when the replay runs off the end
+    hist2 = [4, 6, 4, 6]
+    out = ngram_propose(hist2, 4)
+    assert len(out) == 4 and out[0] == 4
+    assert ngram_propose([9], 0) == []
+
+
+def test_accept_length_prefix_agreement():
+    assert accept_length([1, 2, 3], [1, 2, 3]) == 3
+    assert accept_length([1, 2, 3], [1, 9, 3]) == 1
+    assert accept_length([7], [3]) == 0
+    assert accept_length([], []) == 0
+
+
+# -- scheduler: FIFO requeue + pin invariants ---------------------------------
+
+
+def _mk_sched(num_blocks, n_slots=2, block_size=8, **kw):
+    return Scheduler(n_slots=n_slots, allocator=BlockAllocator(num_blocks),
+                     block_size=block_size, **kw)
+
+
+def test_requeue_restores_fifo_admission_order():
+    s = _mk_sched(num_blocks=16, n_slots=2)
+    reqs = [Request(prompt=[1] * 8, max_new_tokens=8) for _ in range(4)]
+    for i, r in enumerate(reqs):
+        r.t_submit = float(i)
+        s.submit(r)
+    admitted = s.admit()  # reqs[0], reqs[1] -> slots; queue = [2, 3]
+    assert [r.rid for _, r in admitted] == [reqs[0].rid, reqs[1].rid]
+    victim = s.requeue(1)  # reqs[1] goes back
+    # FIFO by t_submit: the older victim lands AHEAD of the younger
+    # queued requests, not at the back and not blindly at the front
+    assert victim is reqs[1]
+    assert [r.rid for r in s.queue] == [reqs[1].rid, reqs[2].rid,
+                                        reqs[3].rid]
+    assert victim.preempted == 1 and not victim.blocks
+    s.check_invariants()
+    # same discipline for capacity preemption
+    s.admit()
+    v2 = s.preempt_youngest()
+    assert v2 is not None
+    assert [r.t_submit for r in s.queue] == sorted(
+        r.t_submit for r in s.queue)
+    s.check_invariants()
+
+
+def test_scheduler_asserts_on_leaked_adapter_pin():
+    model, variables = _model_and_vars()
+    spec = LoraSpec(rank=4)
+    pool = AdapterPool(variables["params"], spec, n_adapters=3)
+    pool.register("t0", random_adapter(variables["params"], spec, seed=1))
+    s = _mk_sched(num_blocks=16, n_slots=2, adapter_pool=pool)
+    req = Request(prompt=[1] * 8, max_new_tokens=8, adapter="t0")
+    s.submit(req)
+    s.admit()
+    got = s.pin_adapter(req)
+    assert got and got["idx"] != IDENTITY_ADAPTER and not got["hit"]
+    s.check_invariants()  # pinned while running: consistent
+    # a prefilling/preempted slot may not hold a pinned adapter
+    req.state = "prefilling"
+    with pytest.raises(AssertionError, match="pinned adapter"):
+        s.check_invariants()
+    req.state = "running"
+    # requeue must drop the pin (else the pool leaks a slot forever)
+    s.requeue(0)
+    assert req.adapter_idx == IDENTITY_ADAPTER
+    assert pool.allocator.n_pinned == 0
+    s.check_invariants()
+
+
+def test_pin_adapter_returns_none_when_pool_exhausted():
+    model, variables = _model_and_vars()
+    spec = LoraSpec(rank=4)
+    pool = AdapterPool(variables["params"], spec, n_adapters=2)  # 1 slot
+    for n in ("t0", "t1"):
+        pool.register(n, random_adapter(variables["params"], spec, seed=1))
+    s = _mk_sched(num_blocks=16, n_slots=2, adapter_pool=pool)
+    r0 = Request(prompt=[1] * 8, max_new_tokens=8, adapter="t0")
+    r1 = Request(prompt=[1] * 8, max_new_tokens=8, adapter="t1")
+    for r in (r0, r1):
+        s.submit(r)
+    s.admit()
+    assert s.pin_adapter(r0)
+    assert s.pin_adapter(r1) is None  # the one slot is pinned by r0
+    assert r1.adapter_idx == IDENTITY_ADAPTER
+    s.check_invariants()
+
+
+# -- engine: multi-adapter parity, ONE trace ----------------------------------
+
+
+@pytest.mark.slow
+def test_multi_adapter_matches_sequential_merged(devices8):
+    """Batched heterogeneous decode — base model + 3 tenants sharing
+    slots — must be token-exact vs merging each tenant's adapter and
+    running generate() alone, AND compile exactly one decode trace."""
+    model, variables = _model_and_vars()
+    spec = LoraSpec(rank=4)
+    eng = ServeEngine(model, variables, n_slots=3, max_len=64,
+                      block_size=8, lora_spec=spec, n_adapters=4)
+    tenants = {f"t{i}": random_adapter(variables["params"], spec,
+                                       seed=10 + i) for i in range(3)}
+    for name, lora in tenants.items():
+        eng.register_adapter(name, lora)
+    rs = np.random.RandomState(0)
+    prompts = [[int(t) for t in rs.randint(1, VOCAB, size=(p,))]
+               for p in (5, 9, 12, 7)]
+    names = [None, "t0", "t1", "t2"]
+    reqs = [eng.submit(p, max_new_tokens=10, eos_id=0, adapter=n)
+            for p, n in zip(prompts, names)]
+    done = eng.run()
+    assert len(done) == 4
+    eng.scheduler.check_invariants()
+    assert eng.adapter_pool.allocator.n_pinned == 0  # all pins drained
+    assert eng._step_fn._cache_size() == 1  # ONE trace for every tenant
+
+    for req, name in zip(reqs, names):
+        ref_vars = (variables if name is None else
+                    _merged_vars(variables, tenants[name], spec))
+        prompt = jnp.asarray(req.prompt, jnp.int32)[None, :]
+        seq, lengths = generate(
+            model, ref_vars, prompt, max_new_tokens=10, eos_id=0,
+            early_stop=True, return_lengths=True)
+        n = int(lengths[0]) - len(req.prompt)
+        expect = [int(t) for t in np.asarray(
+            seq[0, len(req.prompt):len(req.prompt) + n])]
+        assert req.out_tokens == expect, (name, req.out_tokens, expect)
+
+
+@pytest.mark.slow
+def test_int8_adapters_eviction_refault_parity(devices8):
+    """4 tenants through a 2-tenant-slot int8 pool: eviction and
+    re-fault must not perturb tokens (the pool reloads exactly the
+    roundtripped factors effective_lora exposes)."""
+    model, variables = _model_and_vars()
+    spec = LoraSpec(rank=4)
+    eng = ServeEngine(model, variables, n_slots=2, max_len=64,
+                      block_size=8, lora_spec=spec, n_adapters=3,
+                      quant_adapters=True)
+    # seeds matter here the way they do in every greedy-parity test of
+    # an UNTRAINED model: near-uniform logits can sit within fp32
+    # rounding of each other, and the merged-oracle and segmented-delta
+    # paths legitimately sum in different orders.  These seeds have no
+    # near-ties along the trajectory.
+    tenants = {f"t{i}": random_adapter(variables["params"], spec,
+                                       seed=40 + i) for i in range(4)}
+    for name, lora in tenants.items():
+        eng.register_adapter(name, lora)
+    rs = np.random.RandomState(2)
+    reqs = []
+    for i, name in enumerate(["t0", "t1", "t2", "t3", "t0"]):
+        p = [int(t) for t in rs.randint(1, VOCAB, size=(6 + i,))]
+        reqs.append((name, eng.submit(p, max_new_tokens=8, eos_id=0,
+                                      adapter=name)))
+    done = eng.run()
+    assert len(done) == 5
+    assert eng.adapter_pool.allocator.evictions > 0  # refault exercised
+    assert eng._step_fn._cache_size() == 1
+    eng.scheduler.check_invariants()
+    for name, req in reqs:
+        # the oracle merges the POOL's factors (quantized at register),
+        # not the raw fp32 tenant tree — decode serves roundtripped
+        # numbers by design
+        ref_vars = _merged_vars(
+            variables, eng.adapter_pool.effective_lora(name), spec)
+        prompt = jnp.asarray(req.prompt, jnp.int32)[None, :]
+        seq, lengths = generate(
+            model, ref_vars, prompt, max_new_tokens=8, eos_id=0,
+            early_stop=True, return_lengths=True)
+        n = int(lengths[0]) - len(req.prompt)
+        expect = [int(t) for t in np.asarray(
+            seq[0, len(req.prompt):len(req.prompt) + n])]
+        assert req.out_tokens == expect, (name, req.out_tokens, expect)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("attention_impl", ["paged", "dense"])
+def test_speculative_matches_plain_greedy(devices8, attention_impl):
+    """Draft-and-verify emits exactly the plain greedy tokens — the
+    accept rule only ever keeps tokens the target model would have
+    produced — under both decode paths, in one trace."""
+    model, variables = _model_and_vars()
+    rs = np.random.RandomState(5)
+    prompts = [[int(t) for t in rs.randint(1, VOCAB, size=(p,))]
+               for p in (5, 11, 8)]
+
+    plain = ServeEngine(model, variables, n_slots=2, max_len=64,
+                        block_size=8, attention_impl=attention_impl)
+    p_reqs = [plain.submit(p, max_new_tokens=12, eos_id=0)
+              for p in prompts]
+    plain.run()
+
+    spec = ServeEngine(model, variables, n_slots=2, max_len=64,
+                       block_size=8, attention_impl=attention_impl,
+                       speculative=3)
+    s_reqs = [spec.submit(p, max_new_tokens=12, eos_id=0)
+              for p in prompts]
+    spec.run()
+    assert spec._step_fn._cache_size() == 1
+    assert spec.spec_drafted > 0  # drafts actually flowed
+    for pr, sr in zip(p_reqs, s_reqs):
+        assert sr.out_tokens == pr.out_tokens, (pr.out_tokens,
+                                                sr.out_tokens)
+
+
+def test_speculative_requires_greedy_and_headroom():
+    from torch_automatic_distributed_neural_network_tpu.inference import (
+        SampleConfig,
+    )
+
+    model, variables = _model_and_vars()
+    with pytest.raises(ValueError, match="greedy"):
+        ServeEngine(model, variables, n_slots=2, max_len=64, block_size=8,
+                    speculative=2,
+                    sample=SampleConfig(temperature=0.7))
+    eng = ServeEngine(model, variables, n_slots=2, max_len=64,
+                      block_size=8, speculative=4)
+    # exactly at the boundary: 50 + 10 + 4 lookahead == 64 still fits
+    eng.submit([1] * 50, max_new_tokens=10, eos_id=0)
+    with pytest.raises(ValueError, match="exceeds engine max_len"):
+        # 51 prompt + 10 new + 4 lookahead = 65 > 64
+        eng.submit([1] * 51, max_new_tokens=10, eos_id=0)
+
+
+@pytest.mark.slow
+def test_adapter_stall_requeues_without_leaks(devices8):
+    """More concurrent tenants than pool slots: the loser is requeued
+    (FIFO), never wedged, and every pin drains by the end."""
+    model, variables = _model_and_vars()
+    spec = LoraSpec(rank=4)
+    eng = ServeEngine(model, variables, n_slots=3, max_len=64,
+                      block_size=8, lora_spec=spec, n_adapters=2)
+    for i in range(3):
+        eng.register_adapter(
+            f"t{i}", random_adapter(variables["params"], spec, seed=i))
+    rs = np.random.RandomState(2)
+    for i in range(3):  # 3 distinct tenants, 1 tenant slot
+        p = [int(t) for t in rs.randint(1, VOCAB, size=(7,))]
+        eng.submit(p, max_new_tokens=8, eos_id=0, adapter=f"t{i}")
+    done = eng.run()
+    assert len(done) == 3
+    assert all(r.n_generated > 0 for r in done)
+    assert eng.adapter_pool.allocator.n_pinned == 0
+    assert eng.pool.allocator.n_live == 0
+    eng.scheduler.check_invariants()
+
+
+# -- telemetry: report sections -----------------------------------------------
+
+
+def test_report_renders_speculative_and_adapter_sections(tmp_path):
+    jp = tmp_path / "journal.jsonl"
+    recs = [{"kind": "event", "name": "serve.step", "t": 0.1 * i,
+             "step": i, "n_active": 2, "n_queued": 0, "occupancy": 0.5,
+             "free_blocks": 3, "adapters_resident": 2,
+             "adapters_pinned": 1} for i in range(1, 4)]
+    recs += [{"kind": "event", "name": "serve.speculate", "t": 0.05 * i,
+              "step": i, "k": 3, "n_active": 2, "drafted": 6,
+              "accepted": 3, "accept_rate": 0.5} for i in range(1, 3)]
+    recs += [
+        # the kind field overwrites the record kind, like launch.chaos
+        {"kind": "fault", "name": "serve.adapter", "t": 0.01, "rid": 0,
+         "adapter": "t0", "idx": 1, "evicted": None},
+        {"kind": "fault", "name": "serve.adapter", "t": 0.02, "rid": 1,
+         "adapter": "t1", "idx": 2, "evicted": "t9"},
+        {"kind": "hit", "name": "serve.adapter", "t": 0.03, "rid": 2,
+         "adapter": "t0", "idx": 1, "evicted": None},
+        {"kind": "stall", "name": "serve.adapter", "t": 0.04, "rid": 3,
+         "adapter": "t2"},
+        {"kind": "event", "name": "serve.request", "t": 0.4, "rid": 0,
+         "n_prompt": 4, "n_new": 6, "queue_s": 0.01, "total_s": 0.2,
+         "tokens_per_s": 30.0, "preempted": 0},
+    ]
+    with open(jp, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    report = obs_report.generate(str(jp))
+    srv = report["serving"]
+    assert srv["spec_rounds"] == 2 and srv["spec_k"] == 3
+    assert srv["spec_drafted"] == 12 and srv["spec_accepted"] == 6
+    assert srv["spec_accept_rate"] == pytest.approx(0.5)
+    assert srv["adapter_hits"] == 1 and srv["adapter_faults"] == 2
+    assert srv["adapter_evictions"] == 1 and srv["adapter_stalls"] == 1
+    assert srv["adapter_hit_rate"] == pytest.approx(1 / 3)
+    assert srv["mean_adapters_resident"] == pytest.approx(2.0)
+    assert srv["mean_adapters_pinned"] == pytest.approx(1.0)
+    text = obs_report.format_report(report)
+    assert "speculative: k=3" in text and "6/12 drafts accepted" in text
+    assert "adapters:" in text and "hit rate 33.3%" in text
+
+
+# -- serve_estimate: the adapter-pool HBM term --------------------------------
+
+
+def _cfg():
+    return GPT2("test", vocab_size=VOCAB, max_seq_len=64,
+                dtype=jnp.float32, remat=False).cfg
+
+
+def test_serve_estimate_charges_adapter_pool():
+    base_f, base = serve_estimate(_cfg(), budget="8MiB", headroom=0.0,
+                                  block_size=16, max_len=64)
+    with_f, with_ad = serve_estimate(_cfg(), budget="8MiB", headroom=0.0,
+                                     block_size=16, max_len=64,
+                                     adapters=4, adapter_rank=8)
+    # engine pool = tenants + identity slot
+    assert with_ad["adapter_pool_bytes"] == pool_adapter_bytes(
+        _cfg(), rank=8, n_adapters=5)
+    assert with_ad["n_adapters"] == 4 and with_ad["adapter_rank"] == 8
+    assert with_ad["usable_pool_bytes"] < base["usable_pool_bytes"]
+    assert with_ad["max_streams"] <= base["max_streams"]
+    q_f, q = serve_estimate(_cfg(), budget="8MiB", headroom=0.0,
+                            block_size=16, max_len=64, adapters=4,
+                            adapter_rank=8, quant_adapters=True)
+    assert q["adapter_pool_bytes"] < with_ad["adapter_pool_bytes"]
+    assert q["quant_adapters"] is True
+
+
+def test_serve_estimate_ml006_blames_the_adapter_pool():
+    cfg = _cfg()
+    # find a budget that fits >= 1 stream bare but 0 with a huge pool
+    _, bare = serve_estimate(cfg, budget="2MiB", headroom=0.0,
+                             block_size=16, max_len=64)
+    assert bare["max_streams"] >= 1
+    findings, est = serve_estimate(cfg, budget="2MiB", headroom=0.0,
+                                   block_size=16, max_len=64,
+                                   adapters=64, adapter_rank=64)
+    assert est["max_streams"] == 0
+    assert [f.code for f in findings] == ["ML006"]
+    assert findings[0].severity == "error"
+    assert "quant-adapters" in findings[0].msg
+    # a model that never fit stays ML004 — the pool is not to blame
+    findings2, est2 = serve_estimate(cfg, budget=1, headroom=0.0,
+                                     block_size=16, max_len=64,
+                                     adapters=4)
+    assert [f.code for f in findings2] == ["ML004"]
+
+
+def test_report_renders_adapter_pool_in_serve_estimate(tmp_path):
+    jp = tmp_path / "journal.jsonl"
+    rec = {"kind": "event", "name": "lint.serve_estimate", "t": 0.0,
+           "max_streams": 3, "max_len": 64, "num_blocks": 13,
+           "block_size": 16, "quant_kv": False,
+           "attention_impl": "paged", "adapter_pool_bytes": 1966080,
+           "n_adapters": 4, "adapter_rank": 8, "quant_adapters": False}
+    with open(jp, "w") as f:
+        f.write(json.dumps(rec) + "\n")
+    report = obs_report.generate(str(jp))
+    sest = report["serve_estimate"]
+    assert sest["adapter_pool_bytes"] == 1966080
+    assert sest["n_adapters"] == 4
+    text = obs_report.format_report(report)
+    assert "adapter pool 4x r8 f32" in text

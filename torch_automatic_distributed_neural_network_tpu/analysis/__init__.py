@@ -131,6 +131,9 @@ RULES: dict[str, RuleInfo] = {
         RuleInfo("ML005", "mem", WARN,
                  "serving KV pool fits fewer concurrent streams than "
                  "requested"),
+        RuleInfo("ML006", "mem", ERROR,
+                 "serving LoRA adapter pool leaves no HBM for a single "
+                 "KV stream (capacity without it would fit >= 1)"),
         RuleInfo("DT001", "dtype", WARN,
                  "unintended f32→bf16/f16 downcast on the loss/optimizer "
                  "path"),

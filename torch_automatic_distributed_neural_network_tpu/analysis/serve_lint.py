@@ -11,7 +11,10 @@ number and the runtime allocation agree by construction.
 
 Findings land in the shared Finding/RULES vocabulary: **ML004** (error)
 when not even one stream fits, **ML005** (warn) when fewer fit than the
-deployment asked for.  The full estimate is journaled as
+deployment asked for, **ML006** (error) when a multi-tenant LoRA
+adapter pool (inference/serve/adapters.py, charged per-adapter ×
+pool-size, int8-aware) is what pushes an otherwise-serving deployment
+to zero streams.  The full estimate is journaled as
 ``lint.serve_estimate`` for ``tadnn report``.
 """
 
@@ -63,6 +66,9 @@ def serve_estimate(cfg, *,
                    quant_kv: bool = False,
                    params_bytes: int = 0,
                    attention_impl: str = "paged",
+                   adapters: int | None = None,
+                   adapter_rank: int = 8,
+                   quant_adapters: bool = False,
                    degrees: Mapping[str, int] | None = None,
                    ) -> tuple[list[Finding], dict[str, Any]]:
     """(findings, estimate) for a serving deployment of ``cfg``.
@@ -71,6 +77,15 @@ def serve_estimate(cfg, *,
     layout); ``degrees`` shards only the KV pool's head axis, matching
     ``cache_partition_spec``.  ``streams`` is the requested concurrency
     — when given, fitting fewer is an ML005 warning.
+
+    ``adapters`` sizes a multi-tenant LoRA pool (slot 0, the identity
+    adapter, is counted on top — the pool the engine builds holds
+    ``adapters + 1`` entries), charged replicated like the params via
+    ``pool_adapter_bytes`` (default q+v recipe at ``adapter_rank``,
+    int8 payload + fp32 scales when ``quant_adapters``).  When that
+    term alone turns a >=1-stream deployment into a 0-stream one, the
+    finding is ML006, not ML004 — the fix is a smaller/int8 adapter
+    pool, not a smaller KV pool.
 
     ``attention_impl`` matches the engine's knob: the ``"dense"`` decode
     path materializes one layer's gathered K and V views per step
@@ -92,11 +107,28 @@ def serve_estimate(cfg, *,
     block_bytes_dev, block_bytes_global = sharded_tree_bytes(
         one_block, one_spec, degrees)
 
-    usable = int(budget_bytes * (1.0 - headroom)) - int(params_bytes)
+    adapter_pool_bytes = 0
+    if adapters:
+        from ..inference.serve.adapters import pool_adapter_bytes
+
+        # +1: the engine's pool reserves slot 0 for the identity adapter
+        adapter_pool_bytes = pool_adapter_bytes(
+            cfg, rank=adapter_rank, n_adapters=int(adapters) + 1,
+            quantize=quant_adapters)
+
+    usable = (int(budget_bytes * (1.0 - headroom)) - int(params_bytes)
+              - adapter_pool_bytes)
     num_blocks = max(0, usable // max(1, block_bytes_dev))
     blocks_per_stream = blocks_for_tokens(max_len, block_size)
     # one block is the reserved null block (kv_pool.NULL_BLOCK)
     max_streams = max(0, (num_blocks - 1) // blocks_per_stream)
+    # capacity WITHOUT the adapter term — distinguishes "the model
+    # doesn't fit" (ML004) from "the adapter pool ate the KV budget"
+    # (ML006)
+    blocks_sans_adapters = max(
+        0, (usable + adapter_pool_bytes) // max(1, block_bytes_dev))
+    streams_sans_adapters = max(
+        0, (blocks_sans_adapters - 1) // blocks_per_stream)
 
     decode_workspace_bytes = 0
     if attention_impl == "dense":
@@ -129,12 +161,26 @@ def serve_estimate(cfg, *,
         "quant_kv": bool(quant_kv),
         "degrees": degrees,
         "requested_streams": streams,
+        "adapter_pool_bytes": int(adapter_pool_bytes),
+        "n_adapters": int(adapters or 0),
+        "adapter_rank": int(adapter_rank) if adapters else None,
+        "quant_adapters": bool(quant_adapters and adapters),
     }
 
     findings: list[Finding] = []
     where = (f"serve[{cfg.n_layers}L x {cfg.kv_heads}kvH x "
              f"{cfg.head_dim}hd, max_len {max_len}]")
-    if max_streams < 1:
+    if max_streams < 1 and streams_sans_adapters >= 1:
+        findings.append(Finding(
+            "ML006", ERROR, "mem", where,
+            f"the {int(adapters)}-adapter rank-{adapter_rank} LoRA pool "
+            f"({_fmt_bytes(adapter_pool_bytes)}) leaves no usable HBM "
+            f"for even one KV stream ({streams_sans_adapters} would fit "
+            "without it); shrink the pool or rank"
+            + ("" if quant_adapters else
+               ", or --serve-quant-adapters (int8 factors ~quarter the "
+               "pool)")))
+    elif max_streams < 1:
         findings.append(Finding(
             "ML004", ERROR, "mem", where,
             f"KV pool fits 0 streams: {blocks_per_stream} block(s) of "

@@ -707,6 +707,9 @@ def cmd_check(args: argparse.Namespace) -> int:
             streams=args.serve_streams,
             quant_kv=args.serve_quant_kv,
             attention_impl=args.serve_attention_impl,
+            adapters=args.serve_adapters,
+            adapter_rank=args.serve_adapter_rank,
+            quant_adapters=args.serve_quant_adapters,
             params_bytes=params_bytes, **kwargs)
         findings += s_findings
     try:
@@ -738,7 +741,12 @@ def cmd_check(args: argparse.Namespace) -> int:
                   f"{'int8' if serve_est['quant_kv'] else 'bf16'} KV, "
                   f"{serve_est.get('attention_impl', 'paged')} decode"
                   + (f", {ws // 1024} KiB gather workspace" if ws
-                     else "") + ")")
+                     else "")
+                  + (f", adapter pool {serve_est['n_adapters']}x "
+                     f"r{serve_est['adapter_rank']} "
+                     f"{'int8' if serve_est['quant_adapters'] else 'f32'} "
+                     f"({serve_est['adapter_pool_bytes'] // 1024} KiB)"
+                     if serve_est.get("n_adapters") else "") + ")")
         print(f"tadnn check: {summary['errors']} error(s), "
               f"{summary['warnings']} warning(s)")
     return analysis.exit_code(findings, strict=args.strict)
@@ -773,7 +781,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
     import jax
     import jax.numpy as jnp
 
-    from .inference.serve import ServeEngine
+    from .inference.serve import ServeEngine, random_adapter
     from .models import GPT2, Llama, MoE
     from .obs.journal import Journal
 
@@ -793,6 +801,13 @@ def cmd_serve(args: argparse.Namespace) -> int:
         rs.randint(1, cfg.vocab_size, size=(1, prompt_len)), jnp.int32)
     variables = model.init(jax.random.key(1), sample_tokens)
 
+    lora_spec = None
+    n_adapters = int(getattr(args, "adapters", 0) or 0)
+    if n_adapters:
+        from .training.lora import LoraSpec
+
+        lora_spec = LoraSpec(rank=args.adapter_rank)
+
     with Journal(args.journal, host0_only=False,
                  meta={"tool": "serve"}) as jnl:
         eng = ServeEngine(
@@ -804,13 +819,25 @@ def cmd_serve(args: argparse.Namespace) -> int:
             attention_impl=args.attention_impl,
             prefill_chunk=args.prefill_chunk or None,
             admission=args.admission,
+            lora_spec=lora_spec,
+            # +1: slot 0 is the identity adapter
+            n_adapters=n_adapters + 1 if n_adapters else 8,
+            quant_adapters=args.quant_adapters,
+            speculative=args.speculative,
             journal=jnl,
         )
+        for i in range(n_adapters):
+            eng.register_adapter(
+                f"tenant{i}",
+                random_adapter(variables["params"], lora_spec,
+                               seed=args.seed + 100 + i))
         streams = args.streams or 8
-        for _ in range(streams):
+        for j in range(streams):
             prompt = rs.randint(1, cfg.vocab_size, size=(prompt_len,))
             eng.submit([int(t) for t in prompt],
-                       max_new_tokens=args.max_new or 12, eos_id=0)
+                       max_new_tokens=args.max_new or 12, eos_id=0,
+                       adapter=(f"tenant{j % n_adapters}"
+                                if n_adapters else None))
         t0 = time.monotonic()
         done = eng.run()
         wall = time.monotonic() - t0
@@ -840,6 +867,16 @@ def cmd_serve(args: argparse.Namespace) -> int:
             "quant_kv": args.quant_kv,
             "attention_impl": eng.attention_impl,
             "prefill_chunk": eng.prefill_chunk,
+            "adapters": n_adapters,
+            "adapter_rank": lora_spec.rank if lora_spec else None,
+            "quant_adapters": bool(args.quant_adapters and n_adapters),
+            "adapter_hit_rate": (
+                round(eng.adapter_pool.allocator.hit_rate, 4)
+                if eng.adapter_pool is not None else None),
+            "speculative": eng.speculative,
+            "spec_accept_rate": (
+                round(eng.spec_accepted / eng.spec_drafted, 4)
+                if eng.spec_drafted else None),
             "journal": args.journal,
         }
     print(json.dumps(summary))
@@ -1063,6 +1100,20 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--admission", default="reserve",
                    choices=("reserve", "optimistic"),
                    help="block admission policy (scheduler.py)")
+    p.add_argument("--adapters", type=int, default=0,
+                   help="serve N seeded LoRA tenants round-robin through "
+                        "the paged adapter pool (serve/adapters.py); "
+                        "0 = base model only")
+    p.add_argument("--adapter-rank", type=int, default=8,
+                   dest="adapter_rank", help="LoRA rank of the tenants")
+    p.add_argument("--quant-adapters", action="store_true",
+                   dest="quant_adapters",
+                   help="int8 adapter factors "
+                        "(quant.quantize_lora_factor)")
+    p.add_argument("--speculative", type=int, nargs="?", const=4,
+                   default=0, metavar="K",
+                   help="speculative decoding with K n-gram draft "
+                        "tokens per step (bare flag = 4; greedy only)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--journal", default=None,
                    help="journal path for serve.* spans "
@@ -1203,6 +1254,17 @@ def main(argv: list[str] | None = None) -> int:
                    dest="serve_attention_impl",
                    help="decode path to budget: dense charges the "
                         "per-step gather workspace, paged charges 0")
+    p.add_argument("--serve-adapters", type=int, default=None,
+                   dest="serve_adapters",
+                   help="size the multi-tenant LoRA adapter pool "
+                        "(N tenants + identity slot 0); charged against "
+                        "the HBM budget, ML006 when it alone pushes "
+                        "streams to zero")
+    p.add_argument("--serve-adapter-rank", type=int, default=8,
+                   dest="serve_adapter_rank")
+    p.add_argument("--serve-quant-adapters", action="store_true",
+                   dest="serve_quant_adapters",
+                   help="int8 adapter factors (~quarter the pool)")
     p.add_argument("--zero1", action="store_true",
                    help="ZeRO-1 for --memory: shard optimizer moments "
                         "over the data axis (the per-chip optimizer row "

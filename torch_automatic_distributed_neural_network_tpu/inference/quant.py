@@ -116,6 +116,21 @@ def quantize_kv(x):
     return _quantize(x, (jnp.ndim(x) - 1,))
 
 
+def quantize_lora_factor(x):
+    """int8 storage for a LoRA low-rank factor.
+
+    ``x`` is an A-factor ``[.., d_in, r]`` or B-factor ``[.., r, d_out]``
+    — either way the second-to-last axis is the CONTRACTION axis of the
+    rank-r matmul, so the scale reduces over it: one fp32 scale per
+    output channel of the factor, the same per-out-channel scheme the
+    weight path uses.  ``{"q", "scale"}`` leaf convention throughout, so
+    ``is_quantized_leaf``/``dequantize_leaf`` apply unchanged.  Used by
+    the serving adapter pool (inference/serve/adapters.py) to hold
+    ~4x more tenants per byte of HBM.
+    """
+    return _quantize(x, (jnp.ndim(x) - 2,))
+
+
 def dequantize_kv(qkv, dtype=jnp.bfloat16):
     """{"q", "scale"} KV leaf -> dense [.., kvH, hd] in ``dtype``."""
     return dequantize_leaf(qkv, dtype)

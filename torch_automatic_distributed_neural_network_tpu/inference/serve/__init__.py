@@ -1,6 +1,14 @@
 """Continuous-batching serving: paged KV cache, iteration-level
-scheduler, slot-padded jitted decode engine (`tadnn serve`)."""
+scheduler, paged LoRA adapter pool, slot-padded jitted decode engine
+with optional speculative verify steps (`tadnn serve`)."""
 
+from .adapters import (
+    IDENTITY_ADAPTER,
+    AdapterAllocator,
+    AdapterPool,
+    pool_adapter_bytes,
+    random_adapter,
+)
 from .engine import ServeEngine
 from .kv_pool import (
     NULL_BLOCK,
@@ -14,7 +22,10 @@ from .kv_pool import (
 from .scheduler import Request, Scheduler
 
 __all__ = [
+    "IDENTITY_ADAPTER",
     "NULL_BLOCK",
+    "AdapterAllocator",
+    "AdapterPool",
     "BlockAllocator",
     "PagedKVPool",
     "Request",
@@ -22,6 +33,8 @@ __all__ = [
     "ServeEngine",
     "blocks_for_tokens",
     "gather_blocks",
+    "pool_adapter_bytes",
     "pool_kv_bytes",
+    "random_adapter",
     "write_token",
 ]

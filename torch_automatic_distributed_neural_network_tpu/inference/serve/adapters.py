@@ -1,0 +1,393 @@
+"""Paged LoRA adapter pool for multi-tenant serving.
+
+Serving thousands of fine-tuned variants of ONE base model cannot merge
+adapters per request — a merge materializes a full weight copy and
+forces a trace per tenant.  Instead this module mirrors the paged KV
+design (kv_pool.py) one level up: a fixed-shape device pool of low-rank
+factors indexed by adapter slot, so the batched decode step GATHERS each
+sequence slot's (A, B) by integer id and applies the segmented delta
+
+    y = x @ W + scaling * (x @ A_id) @ B_id
+
+inside the scanned layer body.  Heterogeneous tenants (and the base
+model itself) share one jitted trace for the server's life; only the
+``adapter_ids [S]`` operand changes per step.
+
+Layout.  One pool entry ("site") per adapted attention projection, keyed
+``q/k/v/o``, each a pair of stacked factors
+
+    a: [L, A, d_in, r]      b: [L, A, r, d_out]
+
+LAYER-major (A = pool size) so ``jax.lax.scan`` slices per-layer factors
+alongside the weight stack and the KV pool — the kv_pool ``[L, NB, ..]``
+convention, not the ``[A, L, ..]`` order a per-tenant view would
+suggest.  With ``quantize=True`` each factor is int8 with per-out-channel
+fp32 scales (quant.quantize_lora_factor); tenants are quantized ONCE at
+``register()`` so decode, prefill, and any parity oracle all see the
+same roundtripped numbers, and the decode gather dequantizes only the
+gathered rows (embedding_lookup discipline).
+
+Slot 0 is ``IDENTITY_ADAPTER`` — all-zero factors, so its delta is
+exactly 0 and base-model requests run through the same gather unchanged
+(the null-KV-block trick applied to weights).  The allocator hands out
+slots 1..A-1 with LRU eviction and pinned-while-referenced semantics:
+a tenant decoding in some sequence slot can never be evicted out from
+under the live trace; unpinned residents stay warm until capacity
+demands their slot.  Pins are held by RUNNING sequence slots only —
+queued/prefilling requests reference adapters by NAME, which is what
+makes preemption leak-free (scheduler.check_invariants asserts it).
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...planner import path_str
+from ...training.lora import LoraSpec, adapter_shapes
+from ..quant import dequantize_leaf, is_quantized_leaf, quantize_lora_factor
+
+# Slot 0 of every factor stack: all-zero factors, delta exactly 0 — the
+# base model.  Mirrors kv_pool.NULL_BLOCK.
+IDENTITY_ADAPTER = 0
+
+# Only the scanned attention projections are poolable: they are the
+# classic LoRA recipe, their [L, ...] stacks slice through the decode
+# scan, and their matrix views are unambiguous.
+_SITE_RE = re.compile(r"^layers/attn/(q_proj|k_proj|v_proj|o_proj)/kernel$")
+_SITE_KEY = {"q_proj": "q", "k_proj": "k", "v_proj": "v", "o_proj": "o"}
+
+
+class AdapterAllocator:
+    """LRU slot allocator with pin counts over slots 1..n_adapters-1.
+
+    ``acquire`` pins (refcount +1) and faults the name in if absent,
+    evicting the least-recently-used UNPINNED resident when full;
+    returns None when every slot is pinned (caller backs off — in the
+    engine that requeues the request, never stalls the trace).
+    ``release`` unpins but leaves the tenant resident, so a bursty
+    tenant re-acquires its warm slot as a hit.  Mirrors kv_pool's
+    BlockAllocator discipline: loud double-release, ``_live``-style
+    accounting via refcounts, slot 0 never handed out.
+    """
+
+    def __init__(self, n_adapters: int):
+        if n_adapters < 2:
+            raise ValueError(
+                f"n_adapters={n_adapters}: need slot 0 (identity) plus at "
+                "least one tenant slot"
+            )
+        self.n_adapters = n_adapters
+        # LIFO free list like BlockAllocator: slot 1 pops first
+        self._free = list(range(n_adapters - 1, 0, -1))
+        self._slot: dict[str, int] = {}   # resident name -> slot
+        self._refs: dict[str, int] = {}   # resident name -> pin count
+        self._order: list[str] = []       # LRU order, least-recent first
+        self.hits = 0
+        self.faults = 0
+        self.evictions = 0
+
+    @property
+    def n_resident(self) -> int:
+        return len(self._slot)
+
+    @property
+    def n_pinned(self) -> int:
+        return sum(1 for c in self._refs.values() if c > 0)
+
+    @property
+    def hit_rate(self) -> float:
+        total = self.hits + self.faults
+        return self.hits / total if total else 0.0
+
+    def slot_of(self, name: str) -> int | None:
+        return self._slot.get(name)
+
+    def pinned_names(self) -> dict[str, int]:
+        """name -> pin count for every pinned resident (invariant checks)."""
+        return {n: c for n, c in self._refs.items() if c > 0}
+
+    def _touch(self, name: str) -> None:
+        self._order.remove(name)
+        self._order.append(name)
+
+    def acquire(self, name: str) -> tuple[int, bool, str | None] | None:
+        """Pin ``name``; returns (slot, was_resident, evicted_name) or
+        None when every slot is pinned by someone else."""
+        if name in self._slot:
+            self.hits += 1
+            self._refs[name] += 1
+            self._touch(name)
+            return self._slot[name], True, None
+        evicted = None
+        if self._free:
+            slot = self._free.pop()
+        else:
+            victim = next(
+                (n for n in self._order if self._refs[n] == 0), None)
+            if victim is None:
+                return None
+            slot = self._slot.pop(victim)
+            del self._refs[victim]
+            self._order.remove(victim)
+            self.evictions += 1
+            evicted = victim
+        self.faults += 1
+        self._slot[name] = slot
+        self._refs[name] = 1
+        self._order.append(name)
+        return slot, False, evicted
+
+    def release(self, name: str) -> None:
+        if self._refs.get(name, 0) < 1:
+            raise ValueError(
+                f"release of adapter {name!r} that holds no pinned "
+                "reference — double release or never acquired"
+            )
+        self._refs[name] -= 1
+
+    def invalidate(self, name: str) -> None:
+        """Drop an unpinned resident (re-register path).  Pinned -> error:
+        a live decode slot is reading those factors."""
+        if name not in self._slot:
+            return
+        if self._refs[name] > 0:
+            raise ValueError(
+                f"cannot invalidate adapter {name!r}: pinned by "
+                f"{self._refs[name]} running slot(s)"
+            )
+        self._free.append(self._slot.pop(name))
+        del self._refs[name]
+        self._order.remove(name)
+
+
+def _zeros_factor(shape, quantize: bool, dtype):
+    if not quantize:
+        return jnp.zeros(shape, dtype)
+    # int8 q=0 dequantizes to exactly 0 whatever the scale; scales start
+    # at 1 to keep the leaf well-formed
+    return {"q": jnp.zeros(shape, jnp.int8),
+            "scale": jnp.ones(shape[:-2] + (1, shape[-1]), jnp.float32)}
+
+
+def factor_rows(leaf, ids):
+    """Per-slot factor gather: [A, m, n]-leading pool leaf -> [S, m, n]
+    fp32.  int8 leaves dequantize only the GATHERED rows (the
+    embedding_lookup gather-then-dequantize discipline), so the pool
+    itself stays int8 in HBM."""
+    if is_quantized_leaf(leaf):
+        return leaf["q"][ids].astype(jnp.float32) * leaf["scale"][ids]
+    return leaf[ids].astype(jnp.float32)
+
+
+class AdapterPool:
+    """Fixed-shape device pool of per-tenant LoRA factors.
+
+    ``register()`` validates and stages a tenant's factor tree on the
+    host registry (quantizing once if ``quantize``); ``acquire()`` pins
+    it into a device slot (loading on fault); ``release()`` unpins.
+    ``factors`` is the pytree the jitted decode step consumes — its
+    structure and shapes never change after construction, so slot loads
+    (functional ``.at[:, slot].set``) never retrace.  Replicated across
+    devices (factors are rank-r small; sharding them would cost more in
+    collectives than it saves).
+    """
+
+    def __init__(self, base_params, spec: LoraSpec, *, n_adapters: int = 8,
+                 quantize: bool = False, dtype=jnp.float32):
+        self.spec = spec
+        self.n_adapters = int(n_adapters)
+        self.quantize = bool(quantize)
+        self.dtype = dtype
+        self.allocator = AdapterAllocator(self.n_adapters)
+        # key -> (path, L, d_in, d_out); geometry from training/lora.py
+        # so pool layout can't drift from trained factor shapes
+        self.sites: dict[str, tuple[str, int, int, int]] = {}
+        for path, (lead, d_in, d_out) in adapter_shapes(
+                base_params, spec).items():
+            m = _SITE_RE.match(path)
+            if m is None or len(lead) != 1:
+                raise NotImplementedError(
+                    f"the serving adapter pool factorizes the scanned "
+                    f"attention projections (layers/attn/{{q,k,v,o}}_proj) "
+                    f"only, but LoraSpec matched {path!r} with lead dims "
+                    f"{tuple(lead)} — MLP/head/unscanned targets need the "
+                    "merge-per-request path"
+                )
+            self.sites[_SITE_KEY[m.group(1)]] = (path, lead[0], d_in, d_out)
+        self.factors: dict[str, dict] = {}
+        for key, (_, n_layers, d_in, d_out) in self.sites.items():
+            r = spec.rank
+            self.factors[key] = {
+                "a": _zeros_factor((n_layers, self.n_adapters, d_in, r),
+                                   self.quantize, dtype),
+                "b": _zeros_factor((n_layers, self.n_adapters, r, d_out),
+                                   self.quantize, dtype),
+            }
+        self._registry: dict[str, dict] = {}
+
+    # -- host registry ----------------------------------------------------
+
+    def has(self, name: str) -> bool:
+        return name in self._registry
+
+    @property
+    def names(self) -> tuple[str, ...]:
+        return tuple(self._registry)
+
+    def register(self, name: str, lora_params) -> None:
+        """Stage a tenant's factor tree (init_lora_params layout) for
+        later fault-in.  Validates the tree matches this pool's spec
+        exactly; quantizes ONCE here when the pool is int8 so every
+        consumer sees identical roundtripped numbers.  Re-registering a
+        resident-but-unpinned tenant drops its slot (next acquire faults
+        the new factors in); pinned tenants refuse."""
+        flat = jax.tree_util.tree_flatten_with_path(lora_params)[0]
+        got: dict[str, dict] = {}
+        for path, leaf in flat:
+            p = path_str(path)
+            site_path, _, fac = p.rpartition("/")
+            if fac not in ("a", "b"):
+                raise ValueError(
+                    f"adapter {name!r}: unexpected leaf {p!r} — expected "
+                    "{'a', 'b'} factor pairs from init_lora_params"
+                )
+            got.setdefault(site_path, {})[fac] = jnp.asarray(
+                leaf, jnp.float32)
+        want = {path: key for key, (path, *_1) in self.sites.items()}
+        if set(got) != set(want):
+            raise ValueError(
+                f"adapter {name!r} factor sites {sorted(got)} do not match "
+                f"the pool's spec sites {sorted(want)}"
+            )
+        entry: dict[str, dict] = {}
+        for site_path, fac in got.items():
+            key = want[site_path]
+            _, n_layers, d_in, d_out = self.sites[key]
+            r = self.spec.rank
+            a, b = fac.get("a"), fac.get("b")
+            if a is None or b is None:
+                raise ValueError(
+                    f"adapter {name!r}: site {site_path!r} is missing an "
+                    "'a' or 'b' factor"
+                )
+            if a.shape != (n_layers, d_in, r) or b.shape != (n_layers, r,
+                                                             d_out):
+                raise ValueError(
+                    f"adapter {name!r}: site {site_path!r} factor shapes "
+                    f"a{a.shape} / b{b.shape} do not match the pool's "
+                    f"a{(n_layers, d_in, r)} / b{(n_layers, r, d_out)}"
+                )
+            if self.quantize:
+                entry[key] = {"a": quantize_lora_factor(a),
+                              "b": quantize_lora_factor(b)}
+            else:
+                entry[key] = {"a": a.astype(self.dtype),
+                              "b": b.astype(self.dtype)}
+        self.allocator.invalidate(name)
+        self._registry[name] = entry
+
+    def effective_lora(self, name: str):
+        """The EXACT factors decode serves (int8 pools roundtrip through
+        quantization), as the nested fp32 tree ``merge_lora`` consumes.
+        The engine's prefill path and the sequential parity oracle both
+        use this, so prefill KV, the batched segmented decode, and the
+        merge_lora+generate() reference all see one set of numbers."""
+        entry = self._registry[name]
+        out: dict = {}
+        for key, fac in entry.items():
+            path = self.sites[key][0]
+            node = out
+            parts = path.split("/")
+            for k in parts[:-1]:
+                node = node.setdefault(k, {})
+            node[parts[-1]] = {
+                side: (dequantize_leaf(fac[side], jnp.float32)
+                       if is_quantized_leaf(fac[side]) else fac[side])
+                for side in ("a", "b")
+            }
+        return out
+
+    # -- device slots ------------------------------------------------------
+
+    def acquire(self, name: str) -> tuple[int, bool, str | None] | None:
+        """Pin ``name`` into a device slot, loading factors on fault.
+        Returns (slot, was_resident, evicted_name) or None when every
+        slot is pinned."""
+        if name not in self._registry:
+            raise KeyError(
+                f"unknown adapter {name!r} — register() it before submit"
+            )
+        res = self.allocator.acquire(name)
+        if res is None:
+            return None
+        slot, was_resident, evicted = res
+        if not was_resident:
+            self._load(slot, name)
+        return slot, was_resident, evicted
+
+    def release(self, name: str) -> None:
+        self.allocator.release(name)
+
+    def _load(self, slot: int, name: str) -> None:
+        for key, fac in self._registry[name].items():
+            pool = self.factors[key]
+            for side in ("a", "b"):
+                host, leaf = fac[side], pool[side]
+                if self.quantize:
+                    pool[side] = {
+                        "q": leaf["q"].at[:, slot].set(host["q"]),
+                        "scale": leaf["scale"].at[:, slot].set(
+                            host["scale"]),
+                    }
+                else:
+                    pool[side] = leaf.at[:, slot].set(host)
+
+    # -- accounting --------------------------------------------------------
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(int(x.size) * x.dtype.itemsize
+                   for x in jax.tree_util.tree_leaves(self.factors))
+
+
+def pool_adapter_bytes(cfg, *, rank: int, n_adapters: int,
+                       quantize: bool = False) -> int:
+    """Device-free HBM cost of an AdapterPool under the DEFAULT LoraSpec
+    recipe (q_proj + v_proj) — the serve_estimate term.  fp32 factors,
+    or int8 payload + per-out-channel fp32 scales when ``quantize``."""
+    per_adapter_layer = 0
+    q_out = cfg.n_heads * cfg.head_dim
+    v_out = cfg.kv_heads * cfg.head_dim
+    for d_out in (q_out, v_out):
+        a_elems = cfg.d_model * rank
+        b_elems = rank * d_out
+        if quantize:
+            per_adapter_layer += a_elems + 4 * rank      # int8 + [1, r] f32
+            per_adapter_layer += b_elems + 4 * d_out     # int8 + [1, o] f32
+        else:
+            per_adapter_layer += 4 * (a_elems + b_elems)
+    return int(cfg.n_layers) * int(n_adapters) * per_adapter_layer
+
+
+def random_adapter(base_params, spec: LoraSpec, *, seed: int = 0,
+                   scale: float = 0.02):
+    """A seeded random tenant for load-gen, smokes, and benches:
+    init_lora_params geometry with a non-zero B factor so the delta is
+    real (b starts at zero in training init — an all-zero tenant would
+    make multi-tenant parity vacuous)."""
+    from ...training.lora import init_lora_params
+
+    lora = init_lora_params(jax.random.PRNGKey(seed), base_params, spec)
+    rs = np.random.RandomState(seed)
+
+    def bump(path, x):
+        if getattr(path[-1], "key", None) == "b":
+            return jnp.asarray(rs.normal(scale=scale, size=x.shape),
+                               jnp.float32)
+        return x
+
+    return jax.tree_util.tree_map_with_path(bump, lora)

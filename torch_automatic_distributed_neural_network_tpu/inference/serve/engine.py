@@ -40,11 +40,34 @@ block table in-kernel (ops/paged_attention.py — no dense gather);
 ``"dense"`` keeps the reference ``gather_blocks`` + ``xla_attention``
 path the kernel is parity-pinned against.
 
+Multi-tenant LoRA (``lora_spec=...``): each request may name a
+registered adapter; the decode step gathers its (A, B) factors from the
+fixed-shape adapter pool by per-slot id and applies the segmented
+low-rank delta inside the scanned layer body, so heterogeneous tenants
+(and the base model, via identity adapter 0) share the ONE decode
+trace.  Prefill merges the tenant's factors into the weights INSIDE a
+jitted chunk step (rank-r matmul fused into the weight load, factors
+are traced operands — still one chunk trace for every tenant).
+Adapters are pinned in the pool only while their request is RUNNING;
+if every pool slot is pinned when a prefill completes, the request is
+bounced back to the queue recompute-style (see scheduler.requeue).
+
+Speculative decoding (``speculative=k``, greedy only): each step drafts
+k tokens per slot host-side (prompt-lookup n-grams — no draft model),
+verifies ``[last, d_1..d_k]`` in the same batched step (the chunk axis
+T = 1+k is baked into the trace), and accepts the longest agreeing
+prefix plus the target's bonus token — between 1 and k+1 tokens per
+slot per step, token-identical to plain greedy.  Rolled-back draft KV
+needs no cleanup: positions past a slot's context are masked out of
+attention and overwritten by the next step's writes.  Accept rates
+journal as ``serve.speculate`` events.
+
 Telemetry: every finished request journals a ``serve.request`` event
 (queue/prefill/decode/total seconds, tokens/s, preemption count) and
-every step a ``serve.step`` event (slot occupancy, free blocks) through
-``obs.journal`` — ``tadnn report`` renders p50/p99 latency, goodput
-and occupancy from exactly these records.
+every step a ``serve.step`` event (slot occupancy, free blocks,
+adapter residency) through ``obs.journal`` — ``tadnn report`` renders
+p50/p99 latency, goodput, occupancy, and speculative accept rates from
+exactly these records.
 """
 
 from __future__ import annotations
@@ -64,8 +87,10 @@ from ...models.transformer_core import (
     SelfAttention,
     TransformerConfig,
     make_norm,
+    rope,
 )
 from ...obs import journal as _journal
+from ...training.lora import LoraSpec, merge_lora
 from ..decode import (
     KVCache,
     SampleConfig,
@@ -75,6 +100,8 @@ from ..decode import (
 )
 from ..quant import dequantize_leaf, dequantize_tree, embedding_lookup, \
     is_quantized_leaf
+from ..speculative import accept_length, ngram_propose
+from .adapters import IDENTITY_ADAPTER, AdapterPool, factor_rows
 from .kv_pool import (
     PagedKVPool,
     blocks_for_tokens,
@@ -84,28 +111,49 @@ from .kv_pool import (
 from .scheduler import Request, Scheduler
 
 
-def _paged_decode_step(params, kv, tables, ctx_lens, last_tok, active,
-                       rng, *, cfg: TransformerConfig,
+def _paged_decode_step(params, kv, tables, ctx_lens, tok, active,
+                       adapters, adapter_ids, rng, *,
+                       cfg: TransformerConfig,
                        sample: SampleConfig, moe_decode: str,
                        attention_impl: str = "paged",
+                       lora_scaling: float = 1.0,
                        mesh=None, spec=None):
-    """One token for every slot.  [S] vectors throughout; static shapes
-    (S slots, tables [S, max_blocks]) so this traces exactly once.
+    """A [S, T] token chunk for every slot — T == 1 is plain one-token
+    decode, T == 1+k is a speculative verify step (position t attends
+    keys 0..ctx+t, exactly the sequential semantics).  Static shapes
+    throughout (S slots, T chunk, tables [S, max_blocks]) so each
+    engine configuration traces exactly once.
 
     ``attention_impl`` picks the per-layer KV read:
 
     - ``"paged"`` (default): the fused Pallas kernel
       (ops/paged_attention.py) reads the block table in-kernel — the
       dense gathered view never materializes, int8 dequantize happens
-      on load inside the kernel;
+      on load inside the kernel; single-query only, so T > 1 verify
+      steps fall back to the dense path below;
     - ``"dense"``: the reference path — ``gather_blocks`` to a dense
       [S, max_len] view, then stock ``xla_attention`` under an explicit
       mask.  Kept as the parity oracle and the fallback.
+
+    ``adapters`` is the AdapterPool's factor pytree ({} when serving
+    the base model only): per layer and per q/k/v/o site, stacked
+    ``a [A, d_in, r]`` / ``b [A, r, d_out]`` factors.  Each slot
+    gathers its ``adapter_ids`` row and adds the segmented low-rank
+    delta ``scaling * (x @ A) @ B`` to that projection's output —
+    slot 0 holds zero factors (IDENTITY_ADAPTER), so base-model slots
+    pay one gather of zeros instead of a second trace.  q/k deltas are
+    rope-rotated like the projections they perturb (rope is linear, so
+    rotating the delta IS the merged-weight semantics).
+
+    Returns the updated kv plus sampled tokens [S] (T == 1) or the
+    target's greedy choices [S, T] (verify steps are temperature-0 by
+    contract — sampled speculative needs rejection resampling).
     """
     from ...ops.attention import xla_attention
     from ...ops.paged_attention import paged_attention
 
     dtype = cfg.dtype
+    T = tok.shape[1]
     norm = make_norm(cfg)
     attn = SelfAttention(cfg)
     mlp = MLPBlock(cfg)
@@ -117,35 +165,59 @@ def _paged_decode_step(params, kv, tables, ctx_lens, last_tok, active,
             lambda x: jax.lax.with_sharding_constraint(x, sh), kv)
 
     x = embedding_lookup(
-        params["embed"]["embedding"], last_tok[:, None], dtype)  # [S,1,d]
-    positions = ctx_lens[:, None]  # [S, 1] — per-slot rope angles
+        params["embed"]["embedding"], tok, dtype)  # [S, T, d]
+    # per-slot, per-chunk-offset absolute positions
+    positions = ctx_lens[:, None] + jnp.arange(T)[None, :]  # [S, T]
     if cfg.pos == "learned":
         pe = params["pos_embed"].astype(dtype)
         x = x + pe[positions]
 
     mask = None
-    if attention_impl == "dense":
+    if attention_impl == "dense" or T > 1:
         n_keys = tables.shape[1] * (
             kv["k"]["q"] if is_quantized_leaf(kv["k"]) else kv["k"]
         ).shape[2]
-        key_idx = jnp.arange(n_keys)[None, :]
-        # the step writes this token at ctx_lens, then attends keys
-        # 0..ctx_lens inclusive; table padding beyond a slot's blocks
-        # gathers null-block garbage that this mask never admits
-        mask = key_idx <= ctx_lens[:, None]
+        key_idx = jnp.arange(n_keys)[None, None, :]
+        # chunk position t writes at positions[s, t] then attends keys
+        # 0..positions[s, t] inclusive — the causal triangle across the
+        # chunk plus the full context below it; table padding beyond a
+        # slot's blocks gathers null-block garbage this never admits
+        mask = key_idx <= positions[:, :, None]
         if cfg.sliding_window is not None:
-            mask &= key_idx > ctx_lens[:, None] - cfg.sliding_window
-        mask = mask[:, None, None, :]  # [S, 1, 1, K]
+            mask &= key_idx > positions[:, :, None] - cfg.sliding_window
+        mask = mask[:, None]  # [S, 1, T, K]
 
     def layer(x, xs):
-        lp, k_layer, v_layer = xs
+        lp, k_layer, v_layer, ad = xs
         lp = dequantize_tree(lp, dtype)
         h = norm.apply({"params": lp["attn_norm"]}, x)
         q, k, v = attn.apply(
             {"params": lp["attn"]}, h, positions, method="qkv")
-        k_layer = write_token(k_layer, tables, ctx_lens, k[:, 0])
-        v_layer = write_token(v_layer, tables, ctx_lens, v[:, 0])
-        if attention_impl == "paged":
+        if ad:
+            hf = h.astype(jnp.float32)
+
+            def delta(site, inp):
+                a = factor_rows(ad[site]["a"], adapter_ids)  # [S, d_in, r]
+                b = factor_rows(ad[site]["b"], adapter_ids)  # [S, r, d_out]
+                t2 = jnp.einsum("std,sdr->str", inp, a)
+                return lora_scaling * jnp.einsum("str,sro->sto", t2, b)
+
+            def adapted(tensor, site, inp, rotate=False):
+                d = delta(site, inp).reshape(tensor.shape)
+                if rotate and cfg.pos == "rope":
+                    d = rope(d, positions, cfg.rope_theta)
+                return (tensor.astype(jnp.float32) + d).astype(tensor.dtype)
+
+            if "q" in ad:
+                q = adapted(q, "q", hf, rotate=True)
+            if "k" in ad:
+                k = adapted(k, "k", hf, rotate=True)
+            if "v" in ad:
+                v = adapted(v, "v", hf)
+        for t in range(T):  # T is static and small (1 + draft length)
+            k_layer = write_token(k_layer, tables, ctx_lens + t, k[:, t])
+            v_layer = write_token(v_layer, tables, ctx_lens + t, v[:, t])
+        if attention_impl == "paged" and T == 1:
             # fused path: block table consumed in-kernel, same ctx/window
             # mask semantics, no [S, max_len] gather
             o = paged_attention(
@@ -155,8 +227,12 @@ def _paged_decode_step(params, kv, tables, ctx_lens, last_tok, active,
             kd = gather_blocks(k_layer, tables, dtype)
             vd = gather_blocks(v_layer, tables, dtype)
             o = xla_attention(q, kd, vd, causal=False, mask=mask)
-        x = x + attn.apply(
+        ao = attn.apply(
             {"params": lp["attn"]}, o.astype(dtype), method="out_proj")
+        if ad and "o" in ad:
+            of = o.reshape(o.shape[0], o.shape[1], -1).astype(jnp.float32)
+            ao = adapted(ao, "o", of)
+        x = x + ao
         h = norm.apply({"params": lp["mlp_norm"]}, x)
         if "experts_up" in lp["mlp"]:
             x = x + _moe_mlp_cached(lp["mlp"], h, cfg)
@@ -165,10 +241,10 @@ def _paged_decode_step(params, kv, tables, ctx_lens, last_tok, active,
         return x, (k_layer, v_layer)
 
     x, (new_k, new_v) = jax.lax.scan(
-        layer, x, (params["layers"], kv["k"], kv["v"]))
+        layer, x, (params["layers"], kv["k"], kv["v"], adapters))
 
     x = norm.apply({"params": params["final_norm"]}, x)
-    feats = x[:, -1].astype(jnp.float32)
+    feats = x.astype(jnp.float32)  # [S, T, d]
     if cfg.tie_embeddings:
         emb = params["embed"]["embedding"]
         if is_quantized_leaf(emb):
@@ -179,9 +255,13 @@ def _paged_decode_step(params, kv, tables, ctx_lens, last_tok, active,
         if is_quantized_leaf(head):
             head = dequantize_leaf(head, jnp.float32)
         logits = feats @ head.astype(jnp.float32)
-    nxt = _sample(logits, rng, sample)
-    nxt = jnp.where(active, nxt, 0)
-    return {"k": new_k, "v": new_v}, nxt
+    if T == 1:
+        nxt = _sample(logits[:, 0], rng, sample)
+        return {"k": new_k, "v": new_v}, jnp.where(active, nxt, 0)
+    # verify step: the target's own greedy choice at every chunk
+    # position (the all-logits discipline of decode.generate)
+    tgt = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, T]
+    return {"k": new_k, "v": new_v}, jnp.where(active[:, None], tgt, 0)
 
 
 def _prefill_chunk_step(params, tokens, cache, last_idx, *,
@@ -204,14 +284,28 @@ def _prefill_chunk_step(params, tokens, cache, last_idx, *,
     return last, cache
 
 
+def _prefill_chunk_lora_step(params, lora, tokens, cache, last_idx, *,
+                             cfg: TransformerConfig, moe_decode: str,
+                             lora_spec: LoraSpec):
+    """Chunked prefill through per-tenant merged weights: ``merge_lora``
+    runs INSIDE the jit (the rank-r matmul fuses into the weight load),
+    so ONE trace serves every tenant — the factor tree is a traced
+    operand and the merged weights never materialize on the host."""
+    merged = merge_lora(params, lora, lora_spec)
+    return _prefill_chunk_step(merged, tokens, cache, last_idx,
+                               cfg=cfg, moe_decode=moe_decode)
+
+
 @dataclasses.dataclass
 class _PrefillState:
     """Host-side cursor of one in-flight chunked prefill: the [1,
-    max_len] temp cache being filled and how many prompt tokens have
-    streamed through it so far."""
+    max_len] temp cache being filled, how many prompt tokens have
+    streamed through it so far, and the tenant's factor tree (None for
+    base-model requests)."""
 
     cache: KVCache
     pos: int = 0
+    lora: Any = None
 
 
 class ServeEngine:
@@ -241,6 +335,10 @@ class ServeEngine:
                  attention_impl: str = "paged",
                  prefill_chunk: int | None = 32,
                  prefill_chunks_per_step: int = 1,
+                 lora_spec: LoraSpec | None = None,
+                 n_adapters: int = 8,
+                 quant_adapters: bool = False,
+                 speculative: int = 0,
                  mesh=None,
                  rng: jax.Array | None = None,
                  journal: Any = None):
@@ -255,6 +353,14 @@ class ServeEngine:
         self.max_len = max_len
         self.moe_decode = moe_decode
         self.attention_impl = attention_impl
+        self.speculative = int(speculative)
+        if self.speculative < 0:
+            raise ValueError(f"speculative={speculative} must be >= 0")
+        if self.speculative and self.sample.temperature != 0.0:
+            raise ValueError(
+                "speculative decoding is greedy-only (the accept rule "
+                "compares against the target's argmax; sampled variants "
+                "need rejection resampling) — use temperature=0.0")
         if prefill_chunk is not None:
             # snap the chunk to a divisor of max_len: the temp cache is
             # exactly [1, max_len], so the cursor can never run past it
@@ -272,42 +378,86 @@ class ServeEngine:
         self.pool = PagedKVPool(
             self.cfg, num_blocks=num_blocks, block_size=block_size,
             dtype=cache_dtype, quantize=quant_kv, mesh=mesh)
+        self.lora_spec = lora_spec
+        self.adapter_pool: AdapterPool | None = None
+        if lora_spec is not None:
+            self.adapter_pool = AdapterPool(
+                self.params, lora_spec, n_adapters=n_adapters,
+                quantize=quant_adapters)
         self.scheduler = Scheduler(
             n_slots=n_slots, allocator=self.pool.allocator,
-            block_size=block_size, admission=admission)
+            block_size=block_size, admission=admission,
+            adapter_pool=self.adapter_pool,
+            spec_lookahead=self.speculative)
         self.journal = journal or _journal.get_default()
         self._rng = jax.random.key(0) if rng is None else rng
         self._step_count = 0
         self._occupancy_sum = 0.0
+        self.spec_drafted = 0   # lifetime draft-token counters (k > 0)
+        self.spec_accepted = 0
         self.finished: list[Request] = []
         self._prefill: dict[int, _PrefillState] = {}
         self._step_fn = jax.jit(
             partial(_paged_decode_step, cfg=self.cfg, sample=self.sample,
                     moe_decode=moe_decode, attention_impl=attention_impl,
+                    lora_scaling=(lora_spec.scaling if lora_spec else 1.0),
                     mesh=mesh, spec=self.pool.spec),
             donate_argnums=(1,))
         self._prefill_fn = jax.jit(
             partial(_prefill_chunk_step, cfg=self.cfg,
                     moe_decode=moe_decode))
+        self._prefill_lora_fn = None
+        if lora_spec is not None:
+            self._prefill_lora_fn = jax.jit(
+                partial(_prefill_chunk_lora_step, cfg=self.cfg,
+                        moe_decode=moe_decode, lora_spec=lora_spec))
         if self.journal is not None:
             self.journal.event(
                 "serve.engine", attention_impl=attention_impl,
                 prefill_chunk=self.prefill_chunk,
                 n_slots=n_slots, max_len=max_len, block_size=block_size,
-                quant_kv=bool(quant_kv))
+                quant_kv=bool(quant_kv),
+                n_adapters=(n_adapters if lora_spec else 0),
+                adapter_rank=(lora_spec.rank if lora_spec else None),
+                quant_adapters=bool(quant_adapters and lora_spec),
+                speculative=self.speculative)
 
     # -- request intake ------------------------------------------------------
 
+    def register_adapter(self, name: str, lora_params) -> None:
+        """Stage a tenant's LoRA factors for serving (see
+        AdapterPool.register).  Requires ``lora_spec`` at construction."""
+        if self.adapter_pool is None:
+            raise ValueError(
+                "engine built without lora_spec — pass lora_spec=... to "
+                "serve adapters")
+        self.adapter_pool.register(name, lora_params)
+
     def submit(self, prompt: list[int], max_new_tokens: int,
-               eos_id: int | None = None) -> Request:
+               eos_id: int | None = None,
+               adapter: str | None = None) -> Request:
         total = len(prompt) + max_new_tokens
-        if total > self.max_len:
+        # speculative steps write up to k draft keys past the emitted
+        # context — that lookahead must fit the slot's table too
+        need_len = total + self.speculative
+        if need_len > self.max_len:
             raise ValueError(
                 f"prompt {len(prompt)} + max_new_tokens {max_new_tokens} "
-                f"= {total} exceeds engine max_len {self.max_len}")
+                + (f"+ speculative lookahead {self.speculative} "
+                   if self.speculative else "")
+                + f"= {need_len} exceeds engine max_len {self.max_len}")
         if not prompt:
             raise ValueError("empty prompt")
-        need = blocks_for_tokens(total, self.pool.block_size)
+        if adapter is not None:
+            if self.adapter_pool is None:
+                raise ValueError(
+                    "engine built without lora_spec cannot serve "
+                    f"adapter {adapter!r}")
+            if not self.adapter_pool.has(adapter):
+                raise ValueError(
+                    f"unknown adapter {adapter!r} — register_adapter() "
+                    "it first")
+        need = blocks_for_tokens(need_len, self.pool.block_size)
         if need > self.pool.num_blocks - 1:
             # the pool could NEVER cover this request even alone —
             # admitting it would preempt-thrash forever in optimistic
@@ -316,20 +466,52 @@ class ServeEngine:
                 f"request needs {need} blocks but the pool has "
                 f"{self.pool.num_blocks - 1} allocatable")
         req = Request(prompt=list(map(int, prompt)),
-                      max_new_tokens=max_new_tokens, eos_id=eos_id)
+                      max_new_tokens=max_new_tokens, eos_id=eos_id,
+                      adapter=adapter)
         self.scheduler.submit(req)
         return req
 
     # -- one serving iteration ----------------------------------------------
 
+    def _bind_adapter(self, slot: int, req: Request) -> bool:
+        """Pin the request's adapter at the transition into decode
+        (pins back live decode reads ONLY — prefilling slots reference
+        adapters by name).  When every pool slot is pinned by other
+        running requests, the request bounces back to the queue
+        recompute-style; pins are held by running slots only, so some
+        slot is always making progress and the bounce cannot livelock.
+        Size ``n_adapters > n_slots`` to never hit this path."""
+        info = self.scheduler.pin_adapter(req)
+        if info is None:
+            self._prefill.pop(req.rid, None)
+            self.scheduler.requeue(slot)
+            if self.journal is not None:
+                self.journal.event("serve.adapter", kind="stall",
+                                   rid=req.rid, adapter=req.adapter)
+            return False
+        if info and self.journal is not None:
+            self.journal.event(
+                "serve.adapter", kind="hit" if info["hit"] else "fault",
+                rid=req.rid, adapter=req.adapter, idx=info["idx"],
+                evicted=info["evicted"])
+        return True
+
+    def _req_lora(self, req: Request):
+        if req.adapter is None:
+            return None
+        return self.adapter_pool.effective_lora(req.adapter)
+
     def _prefill_into_slot(self, slot: int, req: Request) -> None:
         tokens = jnp.asarray(req.prompt, jnp.int32)[None, :]
         cache = KVCache.init(self.cfg, 1, tokens.shape[1],
                              dtype=jnp.bfloat16)
+        lora = self._req_lora(req)
+        params = (self.params if lora is None
+                  else merge_lora(self.params, lora, self.lora_spec))
         # forward_cached retraces per distinct prompt length — the only
         # shape-varying compile in the serving loop
         logits, cache = forward_cached(
-            self.params, self.cfg, tokens, cache,
+            params, self.cfg, tokens, cache,
             moe_decode=self.moe_decode, mesh=None)
         req_rng = jax.random.fold_in(self._rng, req.rid)
         _, first_rng = jax.random.split(req_rng)
@@ -346,15 +528,21 @@ class ServeEngine:
         the slot to "prefilling" so step() streams the prompt through
         the shared chunk trace, interleaved with decode."""
         if self.prefill_chunk is None:
+            # single-shot requests go straight to running, so the pin
+            # happens here (before the prefill work, cheaply bounced)
+            if not self._bind_adapter(slot, req):
+                return
             self._prefill_into_slot(slot, req)
             return
         req.state = "prefilling"
         self._prefill[req.rid] = _PrefillState(
             cache=KVCache.init(self.cfg, 1, self.max_len,
-                               dtype=jnp.bfloat16))
+                               dtype=jnp.bfloat16),
+            lora=self._req_lora(req))
 
     def _advance_prefill(self, slot: int, req: Request) -> None:
         """One [1, C] chunk of ``req``'s prompt.  On the final chunk:
+        pin the adapter (bouncing the request if the pool is full),
         sample the first token (identical rng derivation to single-shot
         prefill), copy the filled temp-cache rows into the request's
         blocks, and hand the slot to decode."""
@@ -364,11 +552,16 @@ class ServeEngine:
         n_real = len(chunk)
         tokens = jnp.asarray(chunk + [0] * (C - n_real), jnp.int32)[None]
         t0 = time.monotonic()
-        logits, st.cache = self._prefill_fn(
-            self.params, tokens, st.cache, n_real - 1)
+        if st.lora is None:
+            logits, st.cache = self._prefill_fn(
+                self.params, tokens, st.cache, n_real - 1)
+        else:
+            logits, st.cache = self._prefill_lora_fn(
+                self.params, st.lora, tokens, st.cache, n_real - 1)
         st.pos += n_real
         done = st.pos >= req.n_prompt
-        if done:
+        bounced = done and not self._bind_adapter(slot, req)
+        if done and not bounced:
             req_rng = jax.random.fold_in(self._rng, req.rid)
             _, first_rng = jax.random.split(req_rng)
             first = int(jax.device_get(
@@ -386,13 +579,17 @@ class ServeEngine:
             self.journal.event(
                 "serve.prefill_chunk", rid=req.rid, slot=slot,
                 pos=min(st.pos, req.n_prompt), n_tokens=n_real,
-                seconds=time.monotonic() - t0, done=done)
+                seconds=time.monotonic() - t0,
+                done=bool(done and not bounced))
 
     def _decode_all(self) -> None:
         S, MB = self.n_slots, self.max_blocks
+        k_spec = self.speculative
+        T = 1 + k_spec
         tables = np.zeros((S, MB), np.int32)
         ctx = np.zeros((S,), np.int32)
-        last = np.zeros((S,), np.int32)
+        tok = np.zeros((S, T), np.int32)
+        ids = np.zeros((S,), np.int32)
         act = np.zeros((S,), bool)
         for s, req in enumerate(self.scheduler.slots):
             if req is None or req.state != "running":
@@ -405,17 +602,51 @@ class ServeEngine:
             # n_prompt + n_generated - 1 (the first generated token
             # came from prefill and was never written)
             ctx[s] = req.n_prompt + req.n_generated - 1
-            last[s] = req.out_tokens[-1]
+            tok[s, 0] = req.out_tokens[-1]
+            if k_spec:
+                tok[s, 1:] = ngram_propose(
+                    req.prompt + req.out_tokens, k_spec)
+            ids[s] = req.adapter_idx
             act[s] = True
         step_rng = jax.random.fold_in(self._rng, 2**20 + self._step_count)
-        self.pool.kv, nxt = self._step_fn(
+        factors = (self.adapter_pool.factors
+                   if self.adapter_pool is not None else {})
+        self.pool.kv, out = self._step_fn(
             self.params, self.pool.kv, jnp.asarray(tables),
-            jnp.asarray(ctx), jnp.asarray(last), jnp.asarray(act),
-            step_rng)
-        nxt = np.asarray(jax.device_get(nxt))
+            jnp.asarray(ctx), jnp.asarray(tok), jnp.asarray(act),
+            factors, jnp.asarray(ids), step_rng)
+        out = np.asarray(jax.device_get(out))
+        if not k_spec:
+            for s, req in enumerate(self.scheduler.slots):
+                if req is not None and req.state == "running":
+                    req.out_tokens.append(int(out[s]))
+            return
+        drafted = accepted = n_active = 0
         for s, req in enumerate(self.scheduler.slots):
-            if req is not None:
-                req.out_tokens.append(int(nxt[s]))
+            if req is None or req.state != "running":
+                continue
+            n_active += 1
+            drafts = tok[s, 1:]
+            tgt = out[s]  # [1+k] target greedy choices over the chunk
+            a = accept_length(drafts, tgt)
+            # d_1..d_a agreed; tgt[a] is the target's own next token
+            # after them (the free bonus) — 1..k+1 tokens per step
+            emit = [int(d) for d in drafts[:a]] + [int(tgt[a])]
+            drafted += k_spec
+            accepted += a
+            # clip to the generation budget, and stop at EOS exactly
+            # where sequential decode would have
+            emit = emit[:req.max_new_tokens - req.n_generated]
+            if req.eos_id is not None and req.eos_id in emit:
+                emit = emit[:emit.index(req.eos_id) + 1]
+            req.out_tokens.extend(emit)
+        self.spec_drafted += drafted
+        self.spec_accepted += accepted
+        if self.journal is not None:
+            self.journal.event(
+                "serve.speculate", step=self._step_count + 1, k=k_spec,
+                n_active=n_active, drafted=drafted, accepted=accepted,
+                accept_rate=(accepted / drafted if drafted else None))
 
     def _finish(self, slot: int) -> None:
         req = self.scheduler.evict(slot)
@@ -472,13 +703,20 @@ class ServeEngine:
         self._step_count += 1
         self._occupancy_sum += sched.n_active / self.n_slots
         if self.journal is not None:
+            adapter_stats = {}
+            if self.adapter_pool is not None:
+                alloc = self.adapter_pool.allocator
+                adapter_stats = dict(
+                    adapters_resident=alloc.n_resident,
+                    adapters_pinned=alloc.n_pinned)
             self.journal.event(
                 "serve.step", step=self._step_count,
                 n_active=sched.n_active, n_queued=sched.n_queued,
                 n_prefilling=sched.n_prefilling,
                 occupancy=sched.n_active / self.n_slots,
                 free_blocks=self.pool.allocator.n_free,
-                prefill_s=prefill_s, decode_s=decode_s)
+                prefill_s=prefill_s, decode_s=decode_s,
+                **adapter_stats)
 
     @property
     def mean_occupancy(self) -> float | None:

@@ -21,9 +21,21 @@ slot only if the pool can cover its blocks under the chosen policy:
   occupancy (no reservation for tokens that may never be generated —
   most requests stop at EOS early), at the price of mid-decode
   allocation failures resolved by **preempting the youngest slot**:
-  its blocks are freed and the request is re-queued at the FRONT to
-  be recomputed from scratch later (recompute-style preemption — no
+  its blocks are freed and the request is re-queued in FIFO submission
+  order to be recomputed from scratch later (recompute-style — no
   cache swap to host).  ``Request.preempted`` counts the restarts.
+  Requeue position is by ``(t_submit, rid)``, NOT the queue front:
+  front-requeueing let a young victim jump ahead of earlier-submitted
+  requests still waiting for their first admission, inverting FIFO
+  fairness exactly when the pool is most contended.
+
+Multi-tenant state rides along: each request may name a LoRA
+``adapter``; the scheduler pins it in the ``AdapterPool`` exactly when
+the request enters the RUNNING state and unpins on evict/preempt, so
+queued/prefilling/preempted requests never hold a pinned reference
+(``check_invariants`` asserts it — pins only ever back live decode
+reads, and preemption cannot leak adapter slots).
+
 
 The scheduler owns no device state: it moves ``Request`` objects
 between queue and slots and block ids between the allocator and block
@@ -39,6 +51,7 @@ import time
 from collections import deque
 from typing import Any
 
+from .adapters import IDENTITY_ADAPTER
 from .kv_pool import BlockAllocator, blocks_for_tokens
 
 _rid_counter = itertools.count()
@@ -53,6 +66,12 @@ class Request:
     rid: int = dataclasses.field(
         default_factory=lambda: next(_rid_counter))
     eos_id: int | None = None
+    # LoRA tenant: referenced by NAME until the request is running, at
+    # which point the scheduler pins it and adapter_idx holds its pool
+    # slot (IDENTITY_ADAPTER for base-model requests and all non-running
+    # states)
+    adapter: str | None = None
+    adapter_idx: int = IDENTITY_ADAPTER
 
     # lifecycle: queued -> [prefilling ->] running -> done (preemption
     # loops back to queued; "prefilling" only under the engine's
@@ -93,13 +112,18 @@ class Scheduler:
     """Queue + slots + block accounting (host-side, no device state)."""
 
     def __init__(self, *, n_slots: int, allocator: BlockAllocator,
-                 block_size: int, admission: str = "reserve"):
+                 block_size: int, admission: str = "reserve",
+                 adapter_pool=None, spec_lookahead: int = 0):
         if admission not in ("reserve", "optimistic"):
             raise ValueError(f"unknown admission policy {admission!r}")
         self.n_slots = n_slots
         self.allocator = allocator
         self.block_size = block_size
         self.admission = admission
+        self.adapter_pool = adapter_pool
+        # speculative decode writes up to `spec_lookahead` extra KV
+        # positions per step — block coverage must lead by that much
+        self.spec_lookahead = int(spec_lookahead)
         self.queue: deque[Request] = deque()
         self.slots: list[Request | None] = [None] * n_slots
         self.n_finished = 0
@@ -152,6 +176,28 @@ class Scheduler:
         for r in self.queue:
             assert not r.blocks, (
                 f"queued request {r.rid} still holds blocks")
+        # adapter pins back live decode reads ONLY: a slot pins exactly
+        # while running, so preemption/eviction can never leak a pin
+        for r in self.slots:
+            if r is not None and r.state != "running":
+                assert r.adapter_idx == IDENTITY_ADAPTER, (
+                    f"{r.state} request {r.rid} holds a pinned adapter "
+                    f"reference (idx {r.adapter_idx})")
+        for r in self.queue:
+            assert r.adapter_idx == IDENTITY_ADAPTER, (
+                f"queued/preempted request {r.rid} holds a pinned "
+                f"adapter reference (idx {r.adapter_idx})")
+        if self.adapter_pool is not None:
+            want: dict[str, int] = {}
+            for r in self.slots:
+                if (r is not None and r.state == "running"
+                        and r.adapter is not None
+                        and r.adapter_idx != IDENTITY_ADAPTER):
+                    want[r.adapter] = want.get(r.adapter, 0) + 1
+            have = self.adapter_pool.allocator.pinned_names()
+            assert want == have, (
+                f"adapter pin leak: running slots pin {want}, pool "
+                f"holds {have}")
 
     # -- admission / eviction ------------------------------------------------
 
@@ -161,9 +207,64 @@ class Scheduler:
 
     def _blocks_at_admission(self, req: Request) -> int:
         if self.admission == "reserve":
-            return blocks_for_tokens(req.max_tokens_total,
-                                     self.block_size)
+            # worst case includes the speculative write lookahead: a
+            # reserved request must NEVER fail mid-decode
+            return blocks_for_tokens(
+                req.max_tokens_total + self.spec_lookahead,
+                self.block_size)
         return blocks_for_tokens(req.n_prompt, self.block_size)
+
+    # -- adapter pins --------------------------------------------------------
+
+    def pin_adapter(self, req: Request) -> dict | None:
+        """Pin ``req``'s adapter for decode; called exactly at the
+        transition into the RUNNING state.  Returns a fault-info dict
+        ({} for base-model requests), or None when every pool slot is
+        pinned by other running requests — the caller must NOT run the
+        request (the engine requeues it)."""
+        if req.adapter is None or self.adapter_pool is None:
+            return {}
+        got = self.adapter_pool.acquire(req.adapter)
+        if got is None:
+            return None
+        slot, was_resident, evicted = got
+        req.adapter_idx = slot
+        return {"idx": slot, "hit": was_resident, "evicted": evicted}
+
+    def unpin_adapter(self, req: Request) -> None:
+        if req.adapter_idx != IDENTITY_ADAPTER and req.adapter is not None:
+            assert self.adapter_pool is not None
+            self.adapter_pool.release(req.adapter)
+        req.adapter_idx = IDENTITY_ADAPTER
+
+    def _requeue_fifo(self, req: Request) -> None:
+        """Re-insert by ``(t_submit, rid)``: admission order is FIFO by
+        submission, so a bounced request rejoins exactly where its
+        arrival puts it — ahead of later submissions, never ahead of
+        earlier ones still waiting."""
+        key = (req.t_submit, req.rid)
+        idx = next((i for i, r in enumerate(self.queue)
+                    if (r.t_submit, r.rid) > key), len(self.queue))
+        self.queue.insert(idx, req)
+
+    def requeue(self, slot: int) -> Request:
+        """Bounce a slot's request back to the queue (blocks freed,
+        recompute-style) — the adapter-stall path: its prefill finished
+        but every adapter pool slot is pinned by other running requests.
+        Counted as a preemption."""
+        req = self.slots[slot]
+        assert req is not None, f"requeue of empty slot {slot}"
+        self.unpin_adapter(req)
+        self.allocator.free(req.blocks)
+        req.blocks = []
+        req.slot = None
+        req.state = "queued"
+        req.out_tokens = []
+        req.preempted += 1
+        self.n_preemptions += 1
+        self.slots[slot] = None
+        self._requeue_fifo(req)
+        return req
 
     def admit(self) -> list[tuple[int, Request]]:
         """Move queued requests into free slots (FIFO) while the fit
@@ -202,6 +303,7 @@ class Scheduler:
         """Finished request out of its slot; blocks back to the pool."""
         req = self.slots[slot]
         assert req is not None, f"evict of empty slot {slot}"
+        self.unpin_adapter(req)
         self.allocator.free(req.blocks)
         req.blocks = []
         req.slot = None
@@ -213,13 +315,15 @@ class Scheduler:
 
     def preempt_youngest(self) -> Request | None:
         """Free the most-recently-admitted slot's blocks and requeue it
-        at the FRONT (it regenerates from scratch — recompute-style).
-        Returns the victim, or None when no slot is occupied."""
+        in FIFO submission order (it regenerates from scratch —
+        recompute-style).  Returns the victim, or None when no slot is
+        occupied."""
         victims = [r for r in self.slots if r is not None]
         if not victims:
             return None
         victim = max(victims, key=lambda r: r.t_admit or 0.0)
         slot = victim.slot
+        self.unpin_adapter(victim)
         self.allocator.free(victim.blocks)
         victim.blocks = []
         victim.slot = None
@@ -228,15 +332,17 @@ class Scheduler:
         victim.preempted += 1
         self.n_preemptions += 1
         self.slots[slot] = None
-        self.queue.appendleft(victim)
+        self._requeue_fifo(victim)
         return victim
 
     def grow_for_step(self) -> list[Any]:
         """Optimistic mode: before a decode step, every running request
-        about to write token ``ctx`` must own block ``ctx // bs``.
-        Grows tables one block at a time; on allocation failure,
-        preempts the youngest slot and retries (the shrunk batch frees
-        blocks).  Returns the requests that were preempted."""
+        about to write tokens through ``ctx + spec_lookahead`` must own
+        block ``(ctx + spec_lookahead) // bs`` (speculative steps write
+        up to k extra KV positions).  Grows tables one block at a time;
+        on allocation failure, preempts the youngest slot and retries
+        (the shrunk batch frees blocks).  Returns the requests that
+        were preempted."""
         preempted: list[Request] = []
         if self.admission != "optimistic":
             return preempted
@@ -247,16 +353,18 @@ class Scheduler:
                     # prefilling slots own their prompt blocks already
                     # and take no decode write this step
                     break
-                # this step writes KV at absolute position
+                # this step writes KV from absolute position
                 # n_prompt + n_generated - 1 (the first generated token
                 # is produced by prefill, before any paged write)
-                pos = req.n_prompt + req.n_generated - 1
+                # through spec_lookahead positions beyond it
+                pos = (req.n_prompt + req.n_generated - 1
+                       + self.spec_lookahead)
                 if pos // self.block_size < len(req.blocks):
-                    break  # token fits in owned blocks
+                    break  # every write fits in owned blocks
                 got = self.allocator.alloc(1)
                 if got is not None:
                     req.blocks.extend(got)
-                    break
+                    continue  # lookahead may span a second block
                 victim = self.preempt_youngest()
                 if victim is None:
                     raise RuntimeError(

@@ -39,6 +39,49 @@ import jax.numpy as jnp
 from .decode import KVCache, forward_cached
 
 
+def ngram_propose(history, k: int, *, max_n: int = 3) -> list:
+    """Prompt-lookup drafting, host-side: propose ``k`` tokens by
+    matching the longest trailing n-gram (n = max_n..1) against its most
+    recent earlier occurrence in ``history`` and replaying what followed.
+    Free — no draft model, no device work — and surprisingly effective on
+    repetitive serving traffic.  Falls back to repeating the last token,
+    so the proposal is always exactly ``k`` long (the fixed-shape verify
+    chunk needs that).
+    """
+    hist = [int(t) for t in history]
+    if k < 1:
+        return []
+    if not hist:
+        return [0] * k
+    drafts: list = []
+    for n in range(min(max_n, len(hist) - 1), 0, -1):
+        tail = hist[-n:]
+        # most recent earlier occurrence wins (local context beats old)
+        for i in range(len(hist) - n - 1, -1, -1):
+            if hist[i:i + n] == tail:
+                drafts = hist[i + n:i + n + k]
+                break
+        if drafts:
+            break
+    while len(drafts) < k:
+        drafts.append(drafts[-1] if drafts else hist[-1])
+    return drafts[:k]
+
+
+def accept_length(drafts, targets) -> int:
+    """Longest accepted prefix under the greedy-speculative rule:
+    ``drafts[i]`` is accepted while it equals the target's own greedy
+    choice ``targets[i]`` at that position.  Host-side mirror of the
+    argmin-over-agreement inside :func:`speculative_generate`; the serve
+    engine uses it per slot after the batched verify step."""
+    a = 0
+    for d, t in zip(drafts, targets):
+        if int(d) != int(t):
+            break
+        a += 1
+    return a
+
+
 def speculative_generate(
     model,
     variables,

@@ -329,6 +329,35 @@ def generate(target: str, metrics_path: str | None = None) -> dict:
             "attention_impl": (sengine or {}).get("attention_impl"),
             "prefill_chunk": (sengine or {}).get("prefill_chunk"),
         }
+        spec = [e for e in events if e.get("name") == "serve.speculate"]
+        if spec:
+            drafted = sum(_finite(e.get("drafted") for e in spec))
+            accepted = sum(_finite(e.get("accepted") for e in spec))
+            serving["spec_rounds"] = len(spec)
+            serving["spec_k"] = spec[-1].get("k")
+            serving["spec_drafted"] = int(drafted)
+            serving["spec_accepted"] = int(accepted)
+            serving["spec_accept_rate"] = (accepted / drafted
+                                           if drafted else None)
+        sadapt = [e for e in events if e.get("name") == "serve.adapter"]
+        occ_res = _mean(e.get("adapters_resident") for e in ssteps
+                        if e.get("adapters_resident") is not None)
+        if sadapt or occ_res is not None:
+            hits = [e for e in sadapt if e.get("kind") == "hit"]
+            faults = [e for e in sadapt if e.get("kind") == "fault"]
+            serving["adapter_hits"] = len(hits)
+            serving["adapter_faults"] = len(faults)
+            serving["adapter_evictions"] = sum(
+                1 for e in faults if e.get("evicted"))
+            serving["adapter_stalls"] = sum(
+                1 for e in sadapt if e.get("kind") == "stall")
+            binds = len(hits) + len(faults)
+            serving["adapter_hit_rate"] = (len(hits) / binds
+                                           if binds else None)
+            serving["mean_adapters_resident"] = occ_res
+            serving["mean_adapters_pinned"] = _mean(
+                e.get("adapters_pinned") for e in ssteps
+                if e.get("adapters_pinned") is not None)
         report["serving"] = {k: v for k, v in serving.items()
                              if v is not None}
     lint_findings = [e for e in events if e.get("name") == "lint.finding"]
@@ -373,7 +402,8 @@ def generate(target: str, metrics_path: str | None = None) -> dict:
                       "blocks_per_stream", "block_size", "max_len",
                       "quant_kv", "budget_bytes",
                       "block_bytes_per_device", "attention_impl",
-                      "decode_workspace_bytes")
+                      "decode_workspace_bytes", "adapter_pool_bytes",
+                      "n_adapters", "adapter_rank", "quant_adapters")
             if sest.get(k) is not None}
     if metrics_path and os.path.isfile(metrics_path):
         recs = _read_metrics(metrics_path)
@@ -624,6 +654,32 @@ def format_report(report: dict) -> str:
                    if sv.get("prefill_chunk") else ""))
         if bparts:
             lines.append("  " + "  ".join(bparts))
+        if sv.get("spec_rounds"):
+            rate = sv.get("spec_accept_rate")
+            lines.append(
+                f"  speculative: k={sv.get('spec_k')}, "
+                f"{sv.get('spec_accepted', 0)}/{sv.get('spec_drafted', 0)} "
+                "drafts accepted"
+                + (f" ({rate:.1%})" if rate is not None else "")
+                + f" over {sv['spec_rounds']} round(s)")
+        if ("adapter_hits" in sv or "adapter_faults" in sv
+                or sv.get("mean_adapters_resident") is not None):
+            aparts = [
+                f"{sv.get('adapter_hits', 0)} hit(s) / "
+                f"{sv.get('adapter_faults', 0)} fault(s)"]
+            if sv.get("adapter_hit_rate") is not None:
+                aparts.append(f"hit rate {sv['adapter_hit_rate']:.1%}")
+            if sv.get("adapter_evictions"):
+                aparts.append(f"{sv['adapter_evictions']} eviction(s)")
+            if sv.get("adapter_stalls"):
+                aparts.append(f"{sv['adapter_stalls']} pool stall(s)")
+            if sv.get("mean_adapters_resident") is not None:
+                aparts.append(
+                    f"mean resident {sv['mean_adapters_resident']:.1f}"
+                    + (f" (pinned {sv['mean_adapters_pinned']:.1f})"
+                       if sv.get("mean_adapters_pinned") is not None
+                       else ""))
+            lines.append("  adapters: " + "  ".join(aparts))
     sest = report.get("serve_estimate")
     if sest:
         head = (f"serve estimate: {sest.get('max_streams')} stream(s) "
@@ -638,6 +694,11 @@ def format_report(report: dict) -> str:
         if sest.get("decode_workspace_bytes"):
             head += (f" (+{sest['decode_workspace_bytes'] // 1024} KiB "
                      f"gather workspace)")
+        if sest.get("n_adapters"):
+            head += (f", adapter pool {sest['n_adapters']}x "
+                     f"r{sest.get('adapter_rank')} "
+                     f"{'int8' if sest.get('quant_adapters') else 'f32'} "
+                     f"({_fmt_bytes(sest.get('adapter_pool_bytes'))})")
         lines.append(head)
     lint = report.get("lint")
     if lint:

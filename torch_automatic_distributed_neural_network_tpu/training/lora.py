@@ -142,6 +142,34 @@ def matrix_view(shape, target: LoraTarget):
     return lead, d_in, d_out
 
 
+def adapter_shapes(base_params, spec: LoraSpec) -> dict:
+    """``path -> (lead, d_in, d_out)`` for every kernel leaf ``spec``
+    matches — the factor-geometry walk of :func:`init_lora_params`
+    without building arrays (works on abstract/ShapeDtypeStruct trees).
+
+    The serving adapter pool (inference/serve/adapters.py) sizes its
+    fixed-shape factor stacks from exactly this table, so pool layout
+    and training-side factor shapes can never drift apart.  Raises when
+    nothing matches, same as init.
+    """
+    flat = jax.tree_util.tree_flatten_with_path(base_params)[0]
+    out: dict = {}
+    for path, leaf in flat:
+        p = path_str(path)
+        target = spec.resolve(p)
+        if target is None or len(jnp.shape(leaf)) < 2:
+            continue
+        spec.check_matrix_view(p, jnp.shape(leaf))
+        out[p] = matrix_view(jnp.shape(leaf), target)
+    if not out:
+        raise ValueError(
+            f"LoraSpec targets {tuple(spec.targets)} matched no >=2-D "
+            "kernel in the base params — check the patterns against the "
+            "model's param paths"
+        )
+    return out
+
+
 def init_lora_params(rng, base_params, spec: LoraSpec):
     """A/B factor tree for every kernel leaf matching ``spec``.
 
