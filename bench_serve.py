@@ -292,6 +292,7 @@ def run_load(args, journal) -> dict:
             "prompt_len": int(args["prompt_len"]),
             "max_new": int(args["max_new"]),
             "block_size": int(args["block_size"]),
+            "max_len": int(args["max_len"]),
             "quant_kv": bool(int(args["quant_kv"])),
             "attention_impl": impl,
             "prefill_chunk": chunk,

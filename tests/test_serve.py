@@ -467,3 +467,105 @@ def test_check_bench_serve_family_regression_fails(tmp_path):
     code, msgs = obs_report.check_bench(str(tmp_path))
     assert code == 1
     assert any("regressed" in m for m in msgs)
+
+
+# -- pure decision functions (scheduler refactor) -----------------------------
+
+
+def test_pure_admission_plan_matches_scheduler():
+    from torch_automatic_distributed_neural_network_tpu.inference.serve import (
+        admission_plan,
+    )
+
+    for admission in ("reserve", "optimistic"):
+        alloc = BlockAllocator(num_blocks=9)
+        sched = Scheduler(n_slots=4, allocator=alloc, block_size=4,
+                          admission=admission)
+        reqs = [Request(prompt=[1] * 6, max_new_tokens=10)
+                for _ in range(6)]
+        for r in reqs:
+            sched.submit(r)
+        planned = admission_plan(
+            [(r.n_prompt, r.max_new_tokens) for r in sched.queue],
+            n_free_slots=4, n_free_blocks=alloc.n_free,
+            block_size=4, admission=admission)
+        admitted = sched.admit()
+        assert len(admitted) == planned
+        sched.check_invariants()
+
+
+def test_pure_admission_plan_fifo_stops_at_first_nonfit():
+    from torch_automatic_distributed_neural_network_tpu.inference.serve import (
+        admission_plan,
+    )
+
+    # head needs 4 blocks, only 3 free: nothing admits even though the
+    # smaller request behind it would fit (FIFO, no reordering)
+    n = admission_plan([(13, 3), (1, 1)], n_free_slots=2,
+                       n_free_blocks=3, block_size=4,
+                       admission="reserve")
+    assert n == 0
+    # slots bound it too
+    n = admission_plan([(1, 1), (1, 1), (1, 1)], n_free_slots=1,
+                       n_free_blocks=100, block_size=4,
+                       admission="reserve")
+    assert n == 1
+
+
+def test_pure_preemption_victim_matches_scheduler():
+    from torch_automatic_distributed_neural_network_tpu.inference.serve import (
+        preemption_victim,
+    )
+
+    alloc = BlockAllocator(num_blocks=32)
+    sched = Scheduler(n_slots=3, allocator=alloc, block_size=4,
+                      admission="optimistic")
+    for _ in range(3):
+        sched.submit(Request(prompt=[1] * 4, max_new_tokens=4))
+    sched.admit()
+    occupied = [(r.t_admit, r.slot) for r in sched.slots if r is not None]
+    want = preemption_victim(occupied)
+    victim = sched.preempt_youngest()
+    assert victim is not None and victim.slot is None
+    assert want == occupied[-1][1]  # youngest admit = last admitted
+    sched.check_invariants()
+    assert preemption_victim([]) is None
+    # strict > keeps the FIRST max on ties, like max() over slot order
+    assert preemption_victim([(1.0, 0), (1.0, 2)]) == 0
+
+
+def test_pure_decode_needs_block_boundary():
+    from torch_automatic_distributed_neural_network_tpu.inference.serve import (
+        decode_needs_block,
+    )
+
+    # 8 tokens in 2 blocks of 4: next write (pos 8) needs block 3
+    assert not decode_needs_block(6, 2, 2, block_size=4)
+    assert decode_needs_block(6, 3, 2, block_size=4)
+    # speculative lookahead pulls the boundary forward
+    assert decode_needs_block(6, 2, 2, block_size=4, spec_lookahead=1)
+
+
+def test_pure_prefill_schedule_oldest_first():
+    from torch_automatic_distributed_neural_network_tpu.inference.serve import (
+        prefill_schedule,
+    )
+
+    order = prefill_schedule([(3.0, 0), (1.0, 2), (2.0, 1)], 2)
+    assert order == [2, 1]
+    # None admit times sort as 0.0 (first)
+    assert prefill_schedule([(3.0, 0), (None, 2)], 4) == [2, 0]
+
+
+def test_scheduler_injected_clock_drives_timestamps():
+    clock = [100.0]
+    alloc = BlockAllocator(num_blocks=16)
+    sched = Scheduler(n_slots=2, allocator=alloc, block_size=4,
+                      admission="reserve", clock=lambda: clock[0])
+    req = Request(prompt=[1, 2], max_new_tokens=2)
+    sched.submit(req)
+    sched.admit()
+    assert req.t_admit == 100.0
+    clock[0] = 107.5
+    sched.evict(req.slot)
+    assert req.t_done == 107.5

@@ -160,3 +160,49 @@ def test_build_mesh_hybrid_fallthrough_warns(devices8, monkeypatch):
     with pytest.warns(UserWarning, match="FLAT device mesh"):
         mesh = tad.build_mesh(tensor=8)
     assert tad.mesh_degrees(mesh)["tensor"] == 8
+
+
+# -- SKU parsing (what-if sweeps) ---------------------------------------------
+
+
+def test_parse_topology_v5p_1024():
+    topo = topology.parse_topology("v5p-1024")
+    assert topo.num_devices == 1024
+    assert topo.num_hosts == 256  # 4 chips per host
+    assert topo.device_kind == "v5p" and topo.platform == "tpu"
+    assert topo.num_slices == 1
+    assert topo.chip is topology._CHIP_SPECS["v5p"]
+
+
+def test_parse_topology_multislice():
+    topo = topology.parse_topology("v5e-256x4")
+    assert topo.num_devices == 1024 and topo.num_slices == 4
+    assert topo.devices_per_slice == 256
+    assert topo.is_multislice
+
+
+def test_parse_topology_rejects_unknown_sku():
+    with pytest.raises(ValueError, match="unknown TPU SKU"):
+        topology.parse_topology("v9z-16")
+    with pytest.raises(ValueError, match="cannot parse topology"):
+        topology.parse_topology("v5p")
+    with pytest.raises(ValueError, match=">= 1 chip"):
+        topology.parse_topology("v5p-0")
+
+
+def test_parse_topology_dcn_override_changes_chip_and_fingerprint():
+    from torch_automatic_distributed_neural_network_tpu.tune import (
+        cache as tune_cache,
+    )
+
+    base = topology.parse_topology("v5p-64")
+    slow = topology.parse_topology("v5p-64", dcn_bytes_per_s=1e9,
+                                   dcn_latency_s=1e-3)
+    assert base.chip_override is None
+    assert slow.chip_override is not None
+    assert slow.chip.dcn_bytes_per_s == 1e9
+    assert slow.chip.dcn_latency_s == 1e-3
+    # everything but DCN comes from the stock SKU
+    assert slow.chip.flops_per_s == base.chip.flops_per_s
+    assert (tune_cache.topology_fingerprint(base)
+            != tune_cache.topology_fingerprint(slow))

@@ -231,6 +231,13 @@ def cmd_tune(args: argparse.Namespace) -> int:
 
     from . import AutoDistribute, topology, tune
 
+    if getattr(args, "simulate", None):
+        # tune --simulate v5p-64[,v5e-256] == tadnn simulate over those
+        # fleets with this tune invocation's model/search knobs
+        args.topology = [t.strip() for t in args.simulate.split(",")
+                        if t.strip()]
+        return cmd_simulate(args)
+
     model, loss, sample = _family_setup(args)
     ad = AutoDistribute(model, optimizer=optax.adamw(1e-4), loss_fn=loss,
                         precision=args.precision)
@@ -324,6 +331,100 @@ def cmd_tune(args: argparse.Namespace) -> int:
           f"{result.degrees} "
           f"grad_accum={result.grad_accum} ({result.source}; "
           f"cache {tune.cache.cache_path()})")
+    return 0
+
+
+def cmd_simulate(args: argparse.Namespace) -> int:
+    """Fleet-scale what-if planner: sweep hypothetical topologies x
+    parallelism plans and rank the joint prediction (training MFU/step
+    time, HBM headroom, serving tok/s + p99 from a virtual-time replay
+    of the real scheduler, restart-budget survival) against an operator
+    SLO.  Pure shape math + discrete-event simulation — device-free."""
+    import jax
+    import optax
+
+    from . import AutoDistribute, tune
+    from .obs import Journal, set_default
+
+    jnl = Journal(getattr(args, "journal", None))
+    set_default(jnl)
+    model, loss, sample = _family_setup(args)
+    ad = AutoDistribute(model, optimizer=optax.adamw(1e-4), loss_fn=loss,
+                        precision=args.precision)
+    rng = jax.random.key(0)
+    abstract_vars = jax.eval_shape(ad._init_variables, rng, sample)
+    abstract, _ = ad._split_variables(abstract_vars)
+    # transformer families carry a cfg that sizes the serving KV pool;
+    # without one (mlp) the serving columns are simply absent
+    model_cfg = getattr(model, "cfg", None)
+
+    specs = args.topology or ["v5p-16"]
+    try:
+        traffic = tune.TrafficMix.parse(getattr(args, "traffic", None))
+        slo = tune.SLOSpec.parse(getattr(args, "slo", None))
+        adm_raw = getattr(args, "admissions", None) or "reserve,optimistic"
+        admissions = tuple(
+            a.strip() for a in adm_raw.split(",") if a.strip())
+        policy = tune.SimulatePolicy(
+            grad_accums=tuple(
+                int(g) for g in
+                str(getattr(args, "grad_accums", None)
+                    or "1,2,4,8").split(",")),
+            batch_items=tune.estimate_batch_items(sample),
+            admissions=admissions,
+            slots=int(getattr(args, "slots", None) or 8),
+            block_size=int(getattr(args, "block_size", None) or 16),
+            max_len=int(getattr(args, "max_len", None) or 256),
+            prefill_chunk=(int(getattr(args, "prefill_chunk", None) or 32)
+                           or None),
+            preemption_rate_per_h=float(
+                getattr(args, "preemption_rate", None) or 0.0),
+            mission_hours=float(
+                getattr(args, "mission_hours", None) or 24.0),
+            top_k=int(getattr(args, "top_k", None) or 10),
+            use_cache=not getattr(args, "no_cache", False),
+        )
+        report = tune.simulate.simulate(
+            abstract, specs, model_cfg=model_cfg, policy=policy,
+            traffic=traffic, slo=slo)
+    except ValueError as e:
+        # unknown SKU / malformed traffic / malformed SLO — loud + clean
+        print(f"simulate: {e}", file=sys.stderr)
+        return 2
+
+    out_path = getattr(args, "out", None)
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=2)
+    if getattr(args, "json", False):
+        print(json.dumps(report))
+        return 0
+
+    preds = report["predictions"]
+    print(f"simulated {report['n_candidates']} candidates over "
+          f"{len(report['topologies'])} topologies "
+          f"({report['n_slo_ok']} meet the SLO; cache {report['cache']})")
+    print(f"{'rank':>4} {'topology':<12} {'plan':<26} {'adm':<10} "
+          f"{'mfu':>6} {'step_ms':>9} {'hdroom':>7} {'tok/s/c':>8} "
+          f"{'p99_ms':>8} {'occ':>5} {'pre':>4} {'surv':>6} slo")
+    for i, p in enumerate(preds):
+        p99 = (f"{p['p99_s'] * 1e3:>8.1f}" if p.get("p99_s") is not None
+               else f"{'-':>8}")
+        tok = (f"{p['tok_s_per_chip']:>8.1f}"
+               if p.get("tok_s_per_chip") is not None else f"{'-':>8}")
+        occ = (f"{p['mean_occupancy']:>5.2f}"
+               if p.get("mean_occupancy") is not None else f"{'-':>5}")
+        pre = (f"{p['preemptions']:>4d}"
+               if p.get("preemptions") is not None else f"{'-':>4}")
+        print(f"{i:>4} {p['topology']:<12} {p['plan']:<26} "
+              f"{p['admission']:<10} {p['mfu']:>6.3f} "
+              f"{p['step_time_s'] * 1e3:>9.3f} "
+              f"{p['hbm_headroom_frac']:>7.2%} {tok} {p99} {occ} {pre} "
+              f"{p['survival']:>6.3f} "
+              f"{'ok' if p['slo_ok'] else ';'.join(p['slo_violations'])}")
+    if getattr(args, "journal", None):
+        print(f"journal written to {args.journal} (render with "
+              f"`tadnn report {args.journal}`)")
     return 0
 
 
@@ -443,6 +544,11 @@ def cmd_report(args: argparse.Namespace) -> int:
             # per-message verdict: with two trajectories (BENCH + SERVE)
             # one can be fresh while the other fails the aggregate code
             print(("ok   " if ": fresh" in m else "FAIL ") + m)
+        return code
+    if getattr(args, "check_simulate", False):
+        code, msgs = obs_report.check_simulate(args.target)
+        for m in msgs:
+            print(("ok   " if "within 2x" in m else "FAIL ") + m)
         return code
     if args.merge:
         from .obs import aggregate
@@ -985,8 +1091,76 @@ def main(argv: list[str] | None = None) -> int:
                    help="drop the ZeRO-1 optimizer-state-sharding "
                         "variants from the search space (changes the "
                         "cache key)")
+    p.add_argument("--simulate", default=None, metavar="TOPOS",
+                   help="run the fleet-scale what-if sweep over these "
+                        "comma-separated SKUs (e.g. v5p-64,v5e-256) "
+                        "instead of tuning the local topology — "
+                        "shorthand for `tadnn simulate`")
+    p.add_argument("--traffic", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--slo", default=None, help=argparse.SUPPRESS)
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_tune)
+
+    p = sub.add_parser(
+        "simulate",
+        help="fleet-scale what-if planner: sweep hypothetical TPU "
+             "fleets (v5p-1024, v5e-256x4, ...) x parallelism plans "
+             "and rank the joint MFU/HBM/serving/survival prediction "
+             "against an operator SLO — device-free, runs anywhere",
+    )
+    p.add_argument("--topology", action="append", default=None,
+                   metavar="SKU",
+                   help="fleet to sweep, as <kind>-<chips> or "
+                        "<kind>-<chips_per_slice>x<slices> (repeatable; "
+                        "default v5p-16; un-sliced specs fan out over "
+                        "slice counts)")
+    p.add_argument("--family", default="gpt2",
+                   choices=("mlp", "gpt2", "llama", "moe", "bert", "vit"))
+    p.add_argument("--size", default=None,
+                   help="model size preset (default per family; serving "
+                        "predictions need a transformer family)")
+    p.add_argument("--seq", type=int, default=None)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--precision", default="fp32")
+    p.add_argument("--traffic", default=None,
+                   help="serving traffic mix, e.g. "
+                        "'rate=16,n=64,prompt=128,max_new=128,decode=96"
+                        ",jitter=0.5,seed=0' (rate in req/s)")
+    p.add_argument("--slo", default=None,
+                   help="SLO spec, e.g. 'tok_s_chip>=40,p99_ms<=2500,"
+                        "headroom>=0.1,survival>=0.9'")
+    p.add_argument("--grad-accums", default="1,2,4,8",
+                   dest="grad_accums",
+                   help="comma-separated grad-accumulation choices in "
+                        "the training search space")
+    p.add_argument("--admissions", default="reserve,optimistic",
+                   help="comma-separated admission policies to sweep")
+    p.add_argument("--slots", type=int, default=8,
+                   help="decode slots per serving replica")
+    p.add_argument("--block-size", type=int, default=16,
+                   dest="block_size")
+    p.add_argument("--max-len", type=int, default=256, dest="max_len")
+    p.add_argument("--prefill-chunk", type=int, default=32,
+                   dest="prefill_chunk",
+                   help="chunked-prefill size (0 = single-shot prefill)")
+    p.add_argument("--preemption-rate", type=float, default=0.0,
+                   dest="preemption_rate",
+                   help="preemptions per HOST per hour for the "
+                        "restart-budget survival model")
+    p.add_argument("--mission-hours", type=float, default=24.0,
+                   dest="mission_hours")
+    p.add_argument("--top-k", type=int, default=10,
+                   help="ranked candidates to keep in the report")
+    p.add_argument("--no-cache", action="store_true",
+                   help="skip the persistent sweep cache "
+                        "(~/.cache/tadnn/, TADNN_TUNE_CACHE)")
+    p.add_argument("--journal", default=None,
+                   help="journal JSONL to write simulate.* events to")
+    p.add_argument("--out", default=None,
+                   help="write the full JSON report to this file "
+                        "(the CI artifact path)")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_simulate)
 
     p = sub.add_parser(
         "trace",
@@ -1058,6 +1232,12 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--merge", action="store_true",
                    help="merge per-host journals in the target directory "
                         "into journal.merged.jsonl before reporting")
+    p.add_argument("--check-simulate", action="store_true",
+                   dest="check_simulate",
+                   help="crosscheck the simulator against reality: "
+                        "replay the newest SERVE_BENCH record's config "
+                        "through the what-if serve replay and fail when "
+                        "prediction and measurement disagree by >2x")
     p.set_defaults(fn=cmd_report)
 
     p = sub.add_parser(

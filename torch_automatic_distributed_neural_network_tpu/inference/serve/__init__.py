@@ -19,7 +19,15 @@ from .kv_pool import (
     pool_kv_bytes,
     write_token,
 )
-from .scheduler import Request, Scheduler
+from .scheduler import (
+    Request,
+    Scheduler,
+    admission_plan,
+    blocks_at_admission,
+    decode_needs_block,
+    preemption_victim,
+    prefill_schedule,
+)
 
 __all__ = [
     "IDENTITY_ADAPTER",
@@ -31,7 +39,12 @@ __all__ = [
     "Request",
     "Scheduler",
     "ServeEngine",
+    "admission_plan",
+    "blocks_at_admission",
     "blocks_for_tokens",
+    "decode_needs_block",
+    "preemption_victim",
+    "prefill_schedule",
     "gather_blocks",
     "pool_adapter_bytes",
     "pool_kv_bytes",

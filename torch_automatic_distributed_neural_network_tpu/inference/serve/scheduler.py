@@ -49,12 +49,99 @@ import dataclasses
 import itertools
 import time
 from collections import deque
-from typing import Any
+from typing import Any, Callable, Sequence
 
 from .adapters import IDENTITY_ADAPTER
 from .kv_pool import BlockAllocator, blocks_for_tokens
 
 _rid_counter = itertools.count()
+
+
+# -- pure decision functions --------------------------------------------------
+#
+# The scheduler's POLICY, factored out of its pool/slot state: plain
+# functions of integers and tuples, no Request objects, no allocator, no
+# device anywhere.  ``Scheduler`` routes every admission / prefill-order
+# / growth / preemption decision through these, and the what-if
+# simulator (tune/simulate.py) replays serving traffic against the SAME
+# functions — the prediction can never drift from the policy the engine
+# actually runs.  Behavior is pinned by the scheduler invariant tests.
+
+
+def blocks_at_admission(n_prompt: int, max_new_tokens: int, *,
+                        block_size: int, admission: str,
+                        spec_lookahead: int = 0) -> int:
+    """KV blocks a request must be granted to enter a slot.
+
+    ``reserve`` takes the worst case up front (prompt + full generation
+    budget + the speculative write lookahead — a reserved request must
+    NEVER fail mid-decode); ``optimistic`` takes only the prompt's
+    blocks and grows during decode.
+    """
+    if admission == "reserve":
+        return blocks_for_tokens(
+            n_prompt + max_new_tokens + spec_lookahead, block_size)
+    return blocks_for_tokens(n_prompt, block_size)
+
+
+def admission_plan(queued: Sequence[tuple[int, int]], n_free_slots: int,
+                   n_free_blocks: int, *, block_size: int, admission: str,
+                   spec_lookahead: int = 0) -> int:
+    """How many queue-front requests to admit this step.
+
+    ``queued`` is the FIFO queue as ``(n_prompt, max_new_tokens)``
+    pairs.  Walks the front while a free slot remains and the pool
+    covers the fit check; stops at the FIRST request that does not fit
+    (strict FIFO — later, possibly smaller, requests wait rather than
+    jump the queue).
+    """
+    n_admit = 0
+    free = int(n_free_blocks)
+    for n_prompt, max_new in queued:
+        if n_admit >= n_free_slots:
+            break
+        need = blocks_at_admission(
+            n_prompt, max_new, block_size=block_size,
+            admission=admission, spec_lookahead=spec_lookahead)
+        if need > free:
+            break
+        free -= need
+        n_admit += 1
+    return n_admit
+
+
+def prefill_schedule(prefilling: Sequence[tuple[float | None, int]],
+                     max_chunks: int) -> list[int]:
+    """Which prefilling slots advance a chunk this step: FIFO by
+    ``(t_admit, slot)``, at most ``max_chunks`` of them — the cap
+    bounds how much prefill work can delay a step's decode."""
+    order = sorted(((t or 0.0), s) for t, s in prefilling)
+    return [s for _, s in order[:max_chunks]]
+
+
+def decode_needs_block(n_prompt: int, n_generated: int, n_blocks: int, *,
+                       block_size: int, spec_lookahead: int = 0) -> bool:
+    """True when a running request's next decode step writes KV beyond
+    its owned blocks.  This step writes from absolute position
+    ``n_prompt + n_generated - 1`` (the first generated token came from
+    prefill, before any paged write) through ``spec_lookahead``
+    positions beyond it."""
+    pos = n_prompt + n_generated - 1 + spec_lookahead
+    return pos // block_size >= n_blocks
+
+
+def preemption_victim(occupied: Sequence[tuple[float | None, int]]
+                      ) -> int | None:
+    """The slot to preempt: most recently admitted, earliest slot index
+    on ties (``occupied`` is ``(t_admit, slot)`` in slot order).  None
+    when no slot is occupied."""
+    best_t: float | None = None
+    best_slot: int | None = None
+    for t, slot in occupied:
+        t = t or 0.0
+        if best_t is None or t > best_t:
+            best_t, best_slot = t, slot
+    return best_slot
 
 
 @dataclasses.dataclass
@@ -113,7 +200,8 @@ class Scheduler:
 
     def __init__(self, *, n_slots: int, allocator: BlockAllocator,
                  block_size: int, admission: str = "reserve",
-                 adapter_pool=None, spec_lookahead: int = 0):
+                 adapter_pool=None, spec_lookahead: int = 0,
+                 clock: Callable[[], float] = time.monotonic):
         if admission not in ("reserve", "optimistic"):
             raise ValueError(f"unknown admission policy {admission!r}")
         self.n_slots = n_slots
@@ -124,6 +212,9 @@ class Scheduler:
         # speculative decode writes up to `spec_lookahead` extra KV
         # positions per step — block coverage must lead by that much
         self.spec_lookahead = int(spec_lookahead)
+        # timestamps come from here so a discrete-event replay can run
+        # the scheduler on virtual time instead of the wall clock
+        self.clock = clock
         self.queue: deque[Request] = deque()
         self.slots: list[Request | None] = [None] * n_slots
         self.n_finished = 0
@@ -206,13 +297,9 @@ class Scheduler:
         self.queue.append(req)
 
     def _blocks_at_admission(self, req: Request) -> int:
-        if self.admission == "reserve":
-            # worst case includes the speculative write lookahead: a
-            # reserved request must NEVER fail mid-decode
-            return blocks_for_tokens(
-                req.max_tokens_total + self.spec_lookahead,
-                self.block_size)
-        return blocks_for_tokens(req.n_prompt, self.block_size)
+        return blocks_at_admission(
+            req.n_prompt, req.max_new_tokens, block_size=self.block_size,
+            admission=self.admission, spec_lookahead=self.spec_lookahead)
 
     # -- adapter pins --------------------------------------------------------
 
@@ -270,20 +357,23 @@ class Scheduler:
         """Move queued requests into free slots (FIFO) while the fit
         check passes; returns the (slot, request) pairs admitted this
         step — the engine prefills exactly these."""
+        free_slots = [s for s in range(self.n_slots)
+                      if self.slots[s] is None]
+        n_admit = admission_plan(
+            [(r.n_prompt, r.max_new_tokens) for r in self.queue],
+            len(free_slots), self.allocator.n_free,
+            block_size=self.block_size, admission=self.admission,
+            spec_lookahead=self.spec_lookahead)
         admitted: list[tuple[int, Request]] = []
-        for slot in range(self.n_slots):
-            if self.slots[slot] is not None or not self.queue:
-                continue
-            req = self.queue[0]
+        for slot in free_slots[:n_admit]:
+            req = self.queue.popleft()
             got = self.allocator.alloc(self._blocks_at_admission(req))
-            if got is None:
-                break  # FIFO: later (possibly smaller) requests wait
-            self.queue.popleft()
+            assert got is not None, "admission_plan overshot the pool"
             req.blocks = got
             req.slot = slot
             req.state = "running"
             req.out_tokens = []
-            req.t_admit = time.monotonic()
+            req.t_admit = self.clock()
             self.slots[slot] = req
             admitted.append((slot, req))
         return admitted
@@ -293,11 +383,11 @@ class Scheduler:
         admission time, at most ``max_chunks`` of them.  The engine
         advances each returned slot by exactly one chunk, so this cap
         bounds how much prefill work can delay a step's decode."""
-        due = [(r.t_admit or 0.0, r.slot, r)
-               for r in self.slots
-               if r is not None and r.state == "prefilling"]
-        due.sort(key=lambda t: (t[0], t[1]))
-        return [(slot, r) for _, slot, r in due[:max_chunks]]
+        by_slot = {r.slot: r for r in self.slots
+                   if r is not None and r.state == "prefilling"}
+        order = prefill_schedule(
+            [(r.t_admit, s) for s, r in by_slot.items()], max_chunks)
+        return [(slot, by_slot[slot]) for slot in order]
 
     def evict(self, slot: int) -> Request:
         """Finished request out of its slot; blocks back to the pool."""
@@ -308,7 +398,7 @@ class Scheduler:
         req.blocks = []
         req.slot = None
         req.state = "done"
-        req.t_done = time.monotonic()
+        req.t_done = self.clock()
         self.slots[slot] = None
         self.n_finished += 1
         return req
@@ -318,11 +408,12 @@ class Scheduler:
         in FIFO submission order (it regenerates from scratch —
         recompute-style).  Returns the victim, or None when no slot is
         occupied."""
-        victims = [r for r in self.slots if r is not None]
-        if not victims:
+        slot = preemption_victim(
+            [(r.t_admit, r.slot) for r in self.slots if r is not None])
+        if slot is None:
             return None
-        victim = max(victims, key=lambda r: r.t_admit or 0.0)
-        slot = victim.slot
+        victim = self.slots[slot]
+        assert victim is not None
         self.unpin_adapter(victim)
         self.allocator.free(victim.blocks)
         victim.blocks = []
@@ -353,13 +444,10 @@ class Scheduler:
                     # prefilling slots own their prompt blocks already
                     # and take no decode write this step
                     break
-                # this step writes KV from absolute position
-                # n_prompt + n_generated - 1 (the first generated token
-                # is produced by prefill, before any paged write)
-                # through spec_lookahead positions beyond it
-                pos = (req.n_prompt + req.n_generated - 1
-                       + self.spec_lookahead)
-                if pos // self.block_size < len(req.blocks):
+                if not decode_needs_block(
+                        req.n_prompt, req.n_generated, len(req.blocks),
+                        block_size=self.block_size,
+                        spec_lookahead=self.spec_lookahead):
                     break  # every write fits in owned blocks
                 got = self.allocator.alloc(1)
                 if got is not None:

@@ -405,6 +405,39 @@ def generate(target: str, metrics_path: str | None = None) -> dict:
                       "decode_workspace_bytes", "adapter_pool_bytes",
                       "n_adapters", "adapter_rank", "quant_adapters")
             if sest.get(k) is not None}
+    ssweep = last("simulate.sweep")
+    scands = [e for e in events if e.get("name") == "simulate.candidate"]
+    sdec = last("simulate.decision")
+    scross = last("simulate.crosscheck")
+    if ssweep or scands or sdec or scross:
+        sim: dict[str, Any] = {}
+        if ssweep:
+            sim.update({k: ssweep.get(k)
+                        for k in ("n_topologies", "n_candidates",
+                                  "n_replays", "n_slo_ok")
+                        if ssweep.get(k) is not None})
+        if scands:
+            sim["ranked"] = [
+                {k: e.get(k) for k in
+                 ("rank", "topology", "plan", "admission", "mfu",
+                  "step_time_s", "hbm_headroom_frac", "tok_s_per_chip",
+                  "p99_s", "survival", "slo_ok", "slo_violations")}
+                for e in scands]
+        if sdec:
+            sim["decision"] = {
+                k: sdec.get(k) for k in
+                ("topology", "plan", "admission", "slo_ok",
+                 "slo_violations", "mfu", "tok_s_per_chip", "p99_s",
+                 "hbm_headroom_frac", "survival")}
+        if scross:
+            sim["crosscheck"] = {
+                k: scross.get(k) for k in
+                ("record", "predicted_tok_s", "measured_tok_s",
+                 "tok_s_ratio", "predicted_occupancy",
+                 "measured_occupancy", "occupancy_ratio",
+                 "predicted_preemptions", "measured_preemptions",
+                 "within_2x")}
+        report["simulate"] = sim
     if metrics_path and os.path.isfile(metrics_path):
         recs = _read_metrics(metrics_path)
         steps = [r for r in recs if "step_time_s" in r]
@@ -700,6 +733,48 @@ def format_report(report: dict) -> str:
                      f"{'int8' if sest.get('quant_adapters') else 'f32'} "
                      f"({_fmt_bytes(sest.get('adapter_pool_bytes'))})")
         lines.append(head)
+    sim = report.get("simulate")
+    if sim:
+        head = "simulate:"
+        if sim.get("n_candidates") is not None:
+            head += (f" {sim['n_candidates']} candidate(s) over "
+                     f"{sim.get('n_topologies', '?')} topology(ies)")
+            if sim.get("n_replays") is not None:
+                head += f", {sim['n_replays']} serve replay(s)"
+            if sim.get("n_slo_ok") is not None:
+                head += f", {sim['n_slo_ok']} meet the SLO"
+        lines.append(head)
+        for e in (sim.get("ranked") or [])[:8]:
+            mfu = (f"mfu {e['mfu']:.1%}"
+                   if e.get("mfu") is not None else "mfu -")
+            step = (f"step {e['step_time_s'] * 1e3:.1f}ms"
+                    if e.get("step_time_s") is not None else "step -")
+            hd = (f"headroom {e['hbm_headroom_frac']:.0%}"
+                  if e.get("hbm_headroom_frac") is not None
+                  else "headroom -")
+            tok = (f"{e['tok_s_per_chip']:.1f} tok/s/chip"
+                   if e.get("tok_s_per_chip") is not None else "- tok/s")
+            p99 = (f"p99 {e['p99_s'] * 1e3:.0f}ms"
+                   if e.get("p99_s") is not None else "p99 -")
+            surv = (f"surv {e['survival']:.3f}"
+                    if e.get("survival") is not None else "surv -")
+            tail = (" ok" if e.get("slo_ok")
+                    else "  !! " + "; ".join(e.get("slo_violations")
+                                             or ("no SLO result",)))
+            lines.append(
+                f"  #{e.get('rank')} {e.get('topology')} "
+                f"{e.get('plan')} [{e.get('admission')}]  "
+                f"{mfu}  {step}  {hd}  {tok}  {p99}  {surv} " + tail)
+        cc = sim.get("crosscheck")
+        if cc:
+            lines.append(
+                f"  crosscheck vs {cc.get('record')}: "
+                f"tok/s {cc.get('predicted_tok_s')} predicted / "
+                f"{cc.get('measured_tok_s')} measured "
+                f"(ratio {cc.get('tok_s_ratio')}), "
+                f"occupancy ratio {cc.get('occupancy_ratio')}"
+                + ("" if cc.get("within_2x")
+                   else "  !! outside 2x band"))
     lint = report.get("lint")
     if lint:
         head = (f"lint ({lint.get('phase', 'check')}): "
@@ -868,3 +943,97 @@ def _check_bench_family(d: str, prefix: str, *,
                     f"value {rec.get('value')})")
         return 0, msgs
     return 1, msgs
+
+
+# -- simulator crosscheck (`tadnn report --check-simulate`) ------------------
+
+# predicted/measured ratio band the replay must land in.  2x is loose on
+# purpose: the replay models scheduling exactly but step timings only to
+# a roofline, so it catches "the simulator lives in fantasy land", not
+# single-digit-percent drift (that is the --check regression gate's job).
+CROSSCHECK_BAND = 2.0
+
+
+def check_simulate(target: str) -> tuple[int, list[str]]:
+    """Falsify the what-if serve model against the newest real record.
+
+    Behind ``tadnn report --check-simulate``: finds the latest
+    ``SERVE_BENCH_r*.json`` in ``target``, replays its exact recorded
+    config (streams / slots / block size / chunking / measured per-step
+    timings) through the discrete-event scheduler replay, and compares
+    predicted vs measured throughput and occupancy.  Journals the
+    ratios as a ``simulate.crosscheck`` event (within-2x band, same
+    style as ``trace.collective``).  Exit nonzero when no record exists
+    (nothing to falsify against) or a ratio leaves the band — either
+    way the simulator's predictions should not be trusted unaudited.
+    """
+    import glob as _glob
+
+    d = target if os.path.isdir(target) else os.path.dirname(
+        os.path.abspath(target)) or "."
+    rounds = sorted(_glob.glob(os.path.join(d, "SERVE_BENCH_r*.json")))
+    if not rounds:
+        return 1, ["no serve bench record (SERVE_BENCH_r*.json) found — "
+                   "nothing to crosscheck the simulator against"]
+    path = rounds[-1]
+    rec = _load_bench_record(path)
+    if rec is None or not isinstance(rec.get("extra"), dict):
+        return 1, [f"{os.path.basename(path)}: unreadable serve bench "
+                   "record (no extra config to replay)"]
+    name = os.path.basename(path)
+    extra = rec["extra"]
+    # lazy: the replay pulls in the tune package (and with it jax);
+    # everything else in this module stays importable without it.
+    from ..tune.simulate import replay_bench_record
+
+    from . import journal
+
+    try:
+        sim = replay_bench_record(extra)
+    except (KeyError, TypeError, ValueError) as e:
+        return 1, [f"{name}: replay failed on recorded config: {e}"]
+    msgs: list[str] = []
+    within = True
+    measured_tok = rec.get("value") or 0.0
+    measured_occ = extra.get("mean_occupancy")
+    ratios: dict[str, float | None] = {"tok/s": None, "occupancy": None}
+    for label, predicted, measured in (
+            ("tok/s", sim.get("tokens_per_s"), measured_tok),
+            ("occupancy", sim.get("mean_occupancy"), measured_occ)):
+        if not measured or predicted is None:
+            msgs.append(f"{name}: {label} not comparable "
+                        f"(measured {measured!r})")
+            continue
+        ratio = predicted / measured
+        ratios[label] = round(ratio, 4)
+        ok = (1.0 / CROSSCHECK_BAND) <= ratio <= CROSSCHECK_BAND
+        within = within and ok
+        msgs.append(
+            f"{name}: {label} predicted {predicted:g} vs measured "
+            f"{measured:g}, ratio {ratio:.2f} "
+            + ("within 2x" if ok else "OUTSIDE 2x BAND"))
+    pred_pre = sim.get("preemptions", 0)
+    meas_pre = extra.get("preemptions")
+    if meas_pre is not None:
+        # count, not a rate: "within 2x" here means the replay predicts
+        # the same preemption regime (quiet pool vs thrashing pool).
+        ok = pred_pre <= 2 * max(meas_pre, 1) and \
+            meas_pre <= 2 * max(pred_pre, 1)
+        within = within and ok
+        msgs.append(
+            f"{name}: preemptions predicted {pred_pre} vs measured "
+            f"{meas_pre} " + ("within 2x" if ok else "OUTSIDE 2x BAND"))
+    journal.event(
+        "simulate.crosscheck",
+        record=name,
+        predicted_tok_s=sim.get("tokens_per_s"),
+        measured_tok_s=measured_tok or None,
+        tok_s_ratio=ratios["tok/s"],
+        predicted_occupancy=sim.get("mean_occupancy"),
+        measured_occupancy=measured_occ,
+        occupancy_ratio=ratios["occupancy"],
+        predicted_preemptions=pred_pre,
+        measured_preemptions=meas_pre,
+        within_2x=within,
+    )
+    return (0 if within else 1), msgs

@@ -52,7 +52,14 @@ DCN_OK_AXES: tuple[str, ...] = ("pipe", "data")
 
 @dataclasses.dataclass(frozen=True)
 class Topology:
-    """A snapshot of the accelerator topology visible to this process."""
+    """A snapshot of the accelerator topology visible to this process.
+
+    Also the *hypothetical* fleet handle for the what-if planner
+    (:func:`parse_topology`): ``chip_override`` carries a per-sweep
+    :class:`ChipSpec` (e.g. a DCN bandwidth/latency variant) so the
+    tune/simulate cost models can sweep interconnect assumptions
+    without editing the datasheet table.
+    """
 
     num_devices: int
     num_hosts: int
@@ -60,6 +67,7 @@ class Topology:
     device_kind: str
     num_slices: int = 1
     devices_per_slice: int | None = None
+    chip_override: "ChipSpec | None" = None
 
     @property
     def is_multihost(self) -> bool:
@@ -72,6 +80,8 @@ class Topology:
     @property
     def chip(self) -> "ChipSpec":
         """Per-chip peak numbers for this topology's device kind."""
+        if self.chip_override is not None:
+            return self.chip_override
         return chip_spec(self.device_kind)
 
 
@@ -120,6 +130,77 @@ def chip_spec(device_kind: str) -> ChipSpec:
         if k in dk:
             return v
     return _DEFAULT_CHIP
+
+
+# Chips per host for hypothetical fleets: TPU hosts carry 4 chips
+# (v4/v5/v6 boards); the CPU "fleet" is the 8-device host-platform sim.
+_CHIPS_PER_HOST = {"cpu": 8}
+_DEFAULT_CHIPS_PER_HOST = 4
+
+
+def parse_topology(
+    spec: str,
+    *,
+    dcn_bytes_per_s: float | None = None,
+    dcn_latency_s: float | None = None,
+) -> Topology:
+    """A hypothetical :class:`Topology` from a TPU-SKU spelling.
+
+    ``"v5p-1024"`` is a single-slice 1024-chip fleet;
+    ``"v5e-256x4"`` is 4 slices of 256 chips joined by DCN.  The kind
+    must name a known :data:`_CHIP_SPECS` entry EXACTLY — a typo'd SKU
+    must fail the sweep loudly, not silently price a fantasy fleet with
+    the conservative default chip.
+
+    ``dcn_bytes_per_s`` / ``dcn_latency_s`` override the datasheet DCN
+    numbers (stored as ``chip_override``), which is how ``tadnn
+    simulate`` sweeps inter-slice interconnect assumptions.
+    """
+    text = str(spec).strip().lower()
+    kind, sep, shape = text.partition("-")
+    if not sep or not shape:
+        raise ValueError(
+            f"cannot parse topology {spec!r} — expected '<kind>-<chips>' "
+            f"or '<kind>-<chips_per_slice>x<slices>' (e.g. 'v5p-1024', "
+            f"'v5e-256x4')")
+    if kind not in _CHIP_SPECS:
+        raise ValueError(
+            f"unknown TPU SKU {kind!r} in topology {spec!r} — known "
+            f"kinds: {sorted(_CHIP_SPECS)}")
+    per_slice_s, x, slices_s = shape.partition("x")
+    try:
+        per_slice = int(per_slice_s)
+        num_slices = int(slices_s) if x else 1
+    except ValueError:
+        raise ValueError(
+            f"cannot parse topology {spec!r}: {shape!r} is not "
+            f"'<chips>' or '<chips_per_slice>x<slices>'") from None
+    if per_slice < 1 or num_slices < 1:
+        raise ValueError(
+            f"topology {spec!r} needs >= 1 chip per slice and >= 1 "
+            f"slice, got {per_slice}x{num_slices}")
+    num_devices = per_slice * num_slices
+    chip = _CHIP_SPECS[kind]
+    override = None
+    if dcn_bytes_per_s is not None or dcn_latency_s is not None:
+        override = dataclasses.replace(
+            chip,
+            dcn_bytes_per_s=(chip.dcn_bytes_per_s
+                             if dcn_bytes_per_s is None
+                             else float(dcn_bytes_per_s)),
+            dcn_latency_s=(chip.dcn_latency_s if dcn_latency_s is None
+                           else float(dcn_latency_s)),
+        )
+    per_host = _CHIPS_PER_HOST.get(kind, _DEFAULT_CHIPS_PER_HOST)
+    return Topology(
+        num_devices=num_devices,
+        num_hosts=max(1, num_devices // per_host),
+        platform="cpu" if kind == "cpu" else "tpu",
+        device_kind=kind,
+        num_slices=num_slices,
+        devices_per_slice=per_slice,
+        chip_override=override,
+    )
 
 
 _SIZE_UNITS = {

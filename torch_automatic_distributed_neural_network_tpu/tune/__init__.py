@@ -19,16 +19,29 @@ Use it implicitly with ``AutoDistribute(..., strategy='tuned')`` /
 ``tadnn tune`` CLI.  Decisions, cost breakdowns, and measured trials
 are journaled (``tune.*`` events) so ``tadnn report`` shows why a plan
 was chosen.
+
+The fleet-scale what-if layer composes these with the serving and
+resilience models:
+
+- :mod:`.simulate` — sweep hypothetical topologies x plans, predict
+  MFU / HBM headroom / serving tok/s + p99 (discrete-event replay of
+  the real scheduler) / restart survival (``tadnn simulate``)
+- :mod:`.slo` — operator SLO specs the sweep ranks against
 """
 
-from . import cache, cost, measure, space
+from . import cache, cost, measure, simulate, slo, space
 from .cost import CostEstimate, rank, score
+from .simulate import SimulatePolicy, TrafficMix, replay_serve
+from .slo import SLOSpec
 from .space import Candidate, enumerate_candidates, estimate_batch_items
 from .tuner import TunePolicy, TuneResult, tune
 
 __all__ = [
     "Candidate",
     "CostEstimate",
+    "SLOSpec",
+    "SimulatePolicy",
+    "TrafficMix",
     "TunePolicy",
     "TuneResult",
     "cache",
@@ -37,7 +50,10 @@ __all__ = [
     "estimate_batch_items",
     "measure",
     "rank",
+    "replay_serve",
     "score",
+    "simulate",
+    "slo",
     "space",
     "tune",
 ]

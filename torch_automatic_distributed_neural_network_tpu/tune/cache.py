@@ -51,13 +51,19 @@ def params_signature(abstract_params: Any) -> str:
 
 
 def topology_fingerprint(topo: topo_mod.Topology) -> dict:
-    return {
+    fp = {
         "num_devices": topo.num_devices,
         "num_hosts": topo.num_hosts,
         "platform": topo.platform,
         "device_kind": topo.device_kind,
         "num_slices": topo.num_slices,
     }
+    if topo.chip_override is not None:
+        # what-if sweeps may override interconnect numbers per topology
+        # (topology.parse_topology dcn_* args) — a swept variant must
+        # never replay a decision cached under the datasheet chip
+        fp["chip_override"] = dataclasses.asdict(topo.chip_override)
+    return fp
 
 
 def cache_key(
