@@ -29,12 +29,22 @@ Usage (all key=value, bench.py-style):
         [max_new=16] [block_size=8] [quant_kv=0] [seed=0]
         [attention_impl=paged|dense] [prefill_chunk=32]
         [adapters=0] [adapter_rank=8] [quant_adapters=0] [speculative=0]
+        [disaggregate=1] [tp=1]
 
 r03 adds the multi-tenant knobs: ``adapters=N`` registers N random
 rank-``adapter_rank`` LoRA tenants in the engine's paged adapter pool
 (one jitted trace for all of them) and round-robins streams over them;
 ``speculative=K`` turns on K-token n-gram draft-and-verify decode.
 ``extra`` then records the adapter mix and the measured accept rate.
+
+r04 makes the canonical run DISAGGREGATED (``disaggregate=1``): the
+prefill worker loop runs uncapped on its own (virtual) slice, finished
+KV blocks ship into decode slots through the pool, and
+``extra["breakdown"]["phase"]`` records the per-slice busy seconds
+(prefill-slice vs decode-slice, first step dropped as compile) plus the
+serialized and overlapped wall models.  ``tp=N`` shards the KV pool,
+adapter pool and paged kernel over N CPU-sim devices (non-canonical —
+the sim measures scheduling, not sharded-kernel speed).
 
 r02 adds a per-step component breakdown (``extra["breakdown"]``):
 gather / attention / scatter milliseconds per decode step measured by
@@ -67,7 +77,7 @@ def parse_args():
         "block_size": 8, "max_len": 64, "quant_kv": 0, "seed": 0,
         "vocab": 128, "attention_impl": "paged", "prefill_chunk": 32,
         "adapters": 0, "adapter_rank": 8, "quant_adapters": 0,
-        "speculative": 0,
+        "speculative": 0, "disaggregate": 1, "tp": 1,
     }
     for item in sys.argv[1:]:
         k, _, v = item.partition("=")
@@ -217,6 +227,16 @@ def run_load(args, journal) -> dict:
             .lora import LoraSpec
 
         lora_spec = LoraSpec(rank=int(args["adapter_rank"]))
+    tp = int(args["tp"])
+    mesh = None
+    if tp > 1:
+        from jax.sharding import Mesh
+
+        devs = jax.devices()
+        if len(devs) < tp:
+            raise RuntimeError(
+                f"tp={tp} needs {tp} devices, have {len(devs)}")
+        mesh = Mesh(np.array(devs[:tp]), ("tensor",))
     eng = ServeEngine(
         model, variables,
         n_slots=int(args["slots"]),
@@ -229,6 +249,8 @@ def run_load(args, journal) -> dict:
         n_adapters=n_adapters + 1 if n_adapters else 8,
         quant_adapters=bool(int(args["quant_adapters"])),
         speculative=int(args["speculative"]),
+        mesh=mesh,
+        disaggregate=bool(int(args["disaggregate"])),
         journal=journal,
     )
     if n_adapters:
@@ -273,6 +295,22 @@ def run_load(args, journal) -> dict:
     breakdown["prefill_chunk_ms"] = (
         round(1e3 * sum(chunk_ts) / len(chunk_ts), 3)
         if chunk_ts else None)
+    # per-slice phase breakdown from the run's own serve.step records
+    # (first step dropped — it pays trace+compile): what each slice
+    # spent busy, and the wall the steps would cost serialized (one
+    # chip) vs overlapped (disaggregated slices)
+    step_recs = journal.named("serve.step")
+    step_recs = step_recs[1:] if len(step_recs) > 1 else step_recs
+    pf_busy = sum(r.get("prefill_s") or 0.0 for r in step_recs)
+    dec_busy = sum(r.get("decode_s") or 0.0 for r in step_recs)
+    breakdown["phase"] = {
+        "prefill_slice_busy_s": round(pf_busy, 4),
+        "decode_slice_busy_s": round(dec_busy, 4),
+        "serialized_wall_s": round(pf_busy + dec_busy, 4),
+        "overlapped_wall_model_s": round(sum(
+            max(r.get("prefill_s") or 0.0, r.get("decode_s") or 0.0)
+            for r in step_recs), 4),
+    }
     device_kind = jax.devices()[0].device_kind
     on_cpu = jax.default_backend() == "cpu"
     metric = "serve_tokens_per_sec" + ("_cpu_sim" if on_cpu else "")
@@ -296,6 +334,11 @@ def run_load(args, journal) -> dict:
             "quant_kv": bool(int(args["quant_kv"])),
             "attention_impl": impl,
             "prefill_chunk": chunk,
+            "disaggregate": eng.disaggregate,
+            "tp": tp,
+            "kv_ships": eng.pool.n_transfers,
+            "shipped_blocks": eng.pool.transferred_blocks,
+            "shipped_bytes": eng.pool.transferred_bytes,
             "breakdown": breakdown,
             "n_requests": len(done),
             "new_tokens": new_tokens,

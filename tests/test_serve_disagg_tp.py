@@ -1,0 +1,375 @@
+"""Disaggregated and TP-sharded serving parity pins (ISSUE 13).
+
+Two token-parity families on the 8-device CPU sim:
+
+- **disaggregated == colocated**: splitting prefill onto its own slice
+  changes the TIME model only — the phases touch disjoint state (temp
+  prefill caches vs the paged pool), so every request must emit exactly
+  the same greedy tokens, including through optimistic-admission
+  preemption and recompute;
+- **TP == unsharded**: shard_map-ing the paged kernel, KV pool and
+  adapter pool over a 2-device tensor axis is a pure re-layout of the
+  same arithmetic (attention is kv-head-parallel, adapter b factors
+  split the channels the projection already splits), so kernel outputs
+  and engine tokens must match the single-device run — fp and int8 KV,
+  GQA, adapters included.
+
+Plus the capacity-lint fix (serve_estimate charges adapter + KV pool
+per TP shard) and the discrete-event replay's disaggregated mode
+(ship accounting, max-vs-sum wall, DCN pricing knobs).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from torch_automatic_distributed_neural_network_tpu.analysis.serve_lint import (
+    serve_estimate,
+)
+from torch_automatic_distributed_neural_network_tpu.inference.serve import (
+    ServeEngine,
+)
+from torch_automatic_distributed_neural_network_tpu.inference.serve.adapters import (
+    pool_adapter_bytes,
+    random_adapter,
+)
+from torch_automatic_distributed_neural_network_tpu.models import GPT2
+from torch_automatic_distributed_neural_network_tpu.ops.paged_attention import (
+    paged_attention,
+    tensor_degree,
+)
+from torch_automatic_distributed_neural_network_tpu.training.lora import (
+    LoraSpec,
+)
+from torch_automatic_distributed_neural_network_tpu.tune.simulate import (
+    replay_serve,
+)
+
+VOCAB = 128
+
+
+def _model_and_vars(seed=1, p=12):
+    model = GPT2("test", vocab_size=VOCAB, max_seq_len=64,
+                 dtype=jnp.float32, remat=False)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(1, VOCAB, size=(1, p)), jnp.int32)
+    return model, model.init(jax.random.key(seed), tokens)
+
+
+def _prompts(n=6, seed=3, lo=4, hi=20):
+    rs = np.random.RandomState(seed)
+    return [[int(t) for t in rs.randint(1, VOCAB, size=rs.randint(lo, hi))]
+            for _ in range(n)]
+
+
+def _tokens_of(done):
+    return {tuple(r.prompt): list(r.out_tokens) for r in done}
+
+
+def _serve(model, variables, prompts, *, adapters=(), spec=None, **kw):
+    eng = ServeEngine(model, variables, n_slots=4, max_len=64,
+                      block_size=8, prefill_chunk=8, lora_spec=spec,
+                      **kw)
+    for name, lora in adapters:
+        eng.register_adapter(name, lora)
+    names = [a[0] for a in adapters]
+    for i, p in enumerate(prompts):
+        eng.submit(p, max_new_tokens=8, eos_id=None,
+                   adapter=(names[i % (len(names) + 1) - 1]
+                            if names and i % (len(names) + 1) else None))
+    done = eng.run()
+    eng.scheduler.check_invariants()
+    return _tokens_of(done), eng
+
+
+# -- tensor_degree helper -----------------------------------------------------
+
+
+def test_tensor_degree(devices8):
+    assert tensor_degree(None) == 1
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tensor",))
+    assert tensor_degree(mesh) == 2
+    assert tensor_degree(mesh, axis="data") == 1
+
+
+# -- kernel: TP=2 shard_map vs unsharded --------------------------------------
+
+
+@pytest.mark.parametrize("quantized", [False, True])
+def test_kernel_tp2_matches_unsharded(devices8, quantized):
+    """GQA (8q/4kv) paged kernel under a 2-way tensor mesh must equal
+    the single-device kernel bitwise: attention is head-parallel and
+    each GQA group lives wholly on one shard, so no combine exists to
+    introduce drift."""
+    from torch_automatic_distributed_neural_network_tpu.inference.quant \
+        import quantize_kv
+
+    rs = np.random.RandomState(0)
+    S, Hq, kvH, hd, bs, MB, NB = 4, 8, 4, 32, 8, 4, 24
+    k = jnp.asarray(rs.randn(NB, bs, kvH, hd), jnp.float32)
+    v = jnp.asarray(rs.randn(NB, bs, kvH, hd), jnp.float32)
+    if quantized:
+        k, v = quantize_kv(k), quantize_kv(v)
+    tables = np.zeros((S, MB), np.int32)
+    perm = rs.permutation(np.arange(1, NB))[:S * MB].reshape(S, MB)
+    tables[:] = perm
+    tables = jnp.asarray(tables)
+    ctx = jnp.asarray([0, 5, 17, 31], jnp.int32)
+    q = jnp.asarray(rs.randn(S, Hq, hd), jnp.float32)
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tensor",))
+    want = paged_attention(q, k, v, tables, ctx)
+    got = paged_attention(q, k, v, tables, ctx, mesh=mesh)
+    assert float(jnp.max(jnp.abs(got - want))) == 0.0
+
+
+def test_kernel_indivisible_heads_falls_back(devices8):
+    """kvH=3 does not divide tp=2: the dispatch must fall back to the
+    unsharded kernel rather than mis-shard a GQA group."""
+    rs = np.random.RandomState(2)
+    S, Hq, kvH, hd, bs = 2, 6, 3, 16, 8
+    k = jnp.asarray(rs.randn(8, bs, kvH, hd), jnp.float32)
+    v = jnp.asarray(rs.randn(8, bs, kvH, hd), jnp.float32)
+    tables = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+    ctx = jnp.asarray([7, 12], jnp.int32)
+    q = jnp.asarray(rs.randn(S, Hq, hd), jnp.float32)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tensor",))
+    want = paged_attention(q, k, v, tables, ctx)
+    got = paged_attention(q, k, v, tables, ctx, mesh=mesh)
+    assert float(jnp.max(jnp.abs(got - want))) == 0.0
+
+
+# -- engine: disaggregated == colocated ---------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("attention_impl", ["paged", "dense"])
+def test_disaggregated_matches_colocated(devices8, attention_impl):
+    model, variables = _model_and_vars()
+    prompts = _prompts()
+    base, _ = _serve(model, variables, prompts,
+                     attention_impl=attention_impl)
+    dis, eng = _serve(model, variables, prompts,
+                      attention_impl=attention_impl, disaggregate=True)
+    assert dis == base
+    # every finished prefill shipped its blocks exactly once, and the
+    # scheduler accrued the same counters the pool did
+    assert eng.pool.n_transfers == len(prompts)
+    assert eng.scheduler.n_kv_ships == len(prompts)
+    assert eng.scheduler.shipped_blocks == eng.pool.transferred_blocks > 0
+    assert (eng.pool.transferred_bytes
+            == eng.pool.transferred_blocks * eng.pool.bytes_per_block)
+
+
+@pytest.mark.slow
+def test_disaggregated_matches_colocated_int8_kv(devices8):
+    model, variables = _model_and_vars()
+    prompts = _prompts(seed=5)
+    base, _ = _serve(model, variables, prompts, quant_kv=True)
+    dis, _ = _serve(model, variables, prompts, quant_kv=True,
+                    disaggregate=True)
+    assert dis == base
+
+
+@pytest.mark.slow
+def test_disaggregated_preempted_then_recomputed_parity(devices8):
+    """Optimistic admission over a too-small pool forces preempt +
+    recompute; the recomputed prefill re-ships and the tokens still
+    match the colocated run exactly."""
+    model, variables = _model_and_vars()
+
+    def run(disaggregate):
+        eng = ServeEngine(model, variables, n_slots=4, max_len=32,
+                          block_size=8, num_blocks=10,
+                          admission="optimistic", prefill_chunk=8,
+                          disaggregate=disaggregate)
+        for _ in range(4):
+            eng.submit([3] * 12, max_new_tokens=12, eos_id=None)
+        done = eng.run()
+        eng.scheduler.check_invariants()
+        return done, eng
+
+    base_done, _ = run(False)
+    dis_done, eng = run(True)
+    assert eng.scheduler.n_preemptions > 0
+    assert ([r.out_tokens for r in sorted(base_done, key=lambda r: r.rid)]
+            == [r.out_tokens for r in sorted(dis_done, key=lambda r: r.rid)])
+    # a preempted request prefills (and ships) more than once
+    assert eng.pool.n_transfers > 4
+    assert eng.pool.allocator.n_free == 9  # zero leaked blocks
+
+
+# -- engine: TP=2 == unsharded ------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("quant_kv", [False, True])
+def test_engine_tp2_matches_unsharded(devices8, quant_kv):
+    model, variables = _model_and_vars()
+    prompts = _prompts(seed=7)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tensor",))
+    base, _ = _serve(model, variables, prompts, quant_kv=quant_kv)
+    tp, eng = _serve(model, variables, prompts, quant_kv=quant_kv,
+                     mesh=mesh)
+    assert tp == base
+    assert eng.pool.spec is not None  # pool actually sharded
+
+
+@pytest.mark.slow
+def test_engine_tp2_with_adapters_matches_unsharded(devices8):
+    """TP=2 with a sharded adapter pool (b factors split over the
+    tensor axis), fp32 and int8 factors, disaggregated on top — all
+    token-identical to the plain single-device engine."""
+    model, variables = _model_and_vars()
+    spec = LoraSpec(rank=4)
+    adapters = [(f"t{i}", random_adapter(variables["params"], spec,
+                                         seed=10 + i)) for i in range(2)]
+    prompts = _prompts(seed=9, n=6)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tensor",))
+    for quant_adapters in (False, True):
+        base, _ = _serve(model, variables, prompts, adapters=adapters,
+                         spec=spec, n_adapters=4,
+                         quant_adapters=quant_adapters)
+        tp, eng = _serve(model, variables, prompts, adapters=adapters,
+                         spec=spec, n_adapters=4,
+                         quant_adapters=quant_adapters, mesh=mesh,
+                         disaggregate=True)
+        assert tp == base, f"quant_adapters={quant_adapters}"
+        # the wide factor really landed sharded
+        b = eng.adapter_pool.factors["q"]["b"]
+        leaf = b["q"] if isinstance(b, dict) else b
+        assert "tensor" in str(leaf.sharding.spec)
+
+
+# -- serve_estimate: per-shard charging ---------------------------------------
+
+
+def test_pool_adapter_bytes_shards_b_factors():
+    from types import SimpleNamespace
+
+    cfg = SimpleNamespace(n_layers=2, n_heads=16, kv_heads=4, head_dim=64,
+                          d_model=64)
+    full = pool_adapter_bytes(cfg, rank=8, n_adapters=4)
+    tp2 = pool_adapter_bytes(cfg, rank=8, n_adapters=4,
+                             degrees={"tensor": 2})
+    assert pool_adapter_bytes(cfg, rank=8, n_adapters=4,
+                              degrees={"tensor": 1}) == full
+    # a replicated, b split: the drop is exactly the b shards' savings
+    q_out, v_out = 16 * 64, 4 * 64
+    saved = 2 * 4 * 4 * 8 * (q_out // 2 + v_out // 2)  # L*A*4B*rank*o/2
+    assert full - tp2 == saved
+    # indivisible channels stay replicated
+    cfg3 = SimpleNamespace(n_layers=2, n_heads=3, kv_heads=3, head_dim=5,
+                           d_model=15)
+    assert pool_adapter_bytes(cfg3, rank=8, n_adapters=4,
+                              degrees={"tensor": 2}) == \
+        pool_adapter_bytes(cfg3, rank=8, n_adapters=4)
+
+
+def test_serve_estimate_tp_shard_clears_ml006():
+    """A deployment the replicated arithmetic rejects (ML006: adapter
+    pool ate the KV budget) must pass once charged per TP shard — the
+    satellite fix this issue ships."""
+    from types import SimpleNamespace
+
+    # b-heavy geometry: q_out = 16*64 = 1024 >> d_model = 64, so the
+    # sharded b factors dominate the pool
+    cfg = SimpleNamespace(n_layers=4, n_heads=16, kv_heads=4, head_dim=64,
+                          d_model=64)
+    kw = dict(budget="4MiB", headroom=0.0, block_size=16, max_len=256,
+              streams=1, adapters=32, adapter_rank=16)
+    f1, est1 = serve_estimate(cfg, **kw)
+    assert est1["max_streams"] == 0
+    assert [f.code for f in f1] == ["ML006"]
+    f4, est4 = serve_estimate(cfg, degrees={"tensor": 4}, **kw)
+    assert est4["adapter_pool_bytes"] < est1["adapter_pool_bytes"]
+    assert est4["block_bytes_per_device"] < est1["block_bytes_per_device"]
+    assert est4["max_streams"] >= 1
+    assert f4 == []
+
+
+# -- replay: disaggregated mode -----------------------------------------------
+
+
+def _flat_requests(n=6, prompt=32, max_new=16, decode=16):
+    return [(0.0, prompt, max_new, decode) for _ in range(n)]
+
+
+def test_replay_disaggregate_overlaps_phases():
+    """Same traffic, same step costs: the disaggregated wall is the
+    per-step max of the phases, so it must land strictly under the
+    colocated sum whenever both phases are busy — with identical token
+    and scheduling outcomes."""
+    reqs = _flat_requests()
+    kw = dict(n_slots=4, block_size=8, max_len=64, prefill_chunk=8,
+              decode_step_s=1e-3, prefill_chunk_s=1e-3)
+    co = replay_serve(reqs, **kw)
+    di = replay_serve(reqs, disaggregate=True, **kw)
+    assert not co["disaggregate"] and di["disaggregate"]
+    assert di["n_finished"] == co["n_finished"] == len(reqs)
+    assert di["new_tokens"] == co["new_tokens"]
+    assert di["wall_s"] < co["wall_s"]
+    assert di["kv_ships"] == len(reqs)
+    assert di["shipped_blocks"] == len(reqs) * 4  # 32 tokens / 8-blocks
+    assert co["kv_ships"] == 0
+    # busy time is conserved: overlap hides it, never deletes it
+    assert di["decode_busy_s"] == pytest.approx(
+        co["decode_busy_s"], rel=0.2)
+
+
+def test_replay_prices_kv_ship_and_dcn():
+    reqs = _flat_requests(n=4)
+    kw = dict(n_slots=4, block_size=8, max_len=64, prefill_chunk=8,
+              decode_step_s=1e-3, prefill_chunk_s=1e-3)
+    base = replay_serve(reqs, disaggregate=True, **kw)
+    shipped = replay_serve(reqs, disaggregate=True, kv_ship_s=5e-3, **kw)
+    taxed = replay_serve(reqs, dcn_step_s=5e-4, **kw)
+    # the ship charge lands on the prefill side, the DCN tax on decode
+    assert shipped["prefill_busy_s"] == pytest.approx(
+        base["prefill_busy_s"] + 4 * 5e-3)
+    assert shipped["wall_s"] > base["wall_s"]
+    untaxed = replay_serve(reqs, **kw)
+    assert taxed["decode_busy_s"] > untaxed["decode_busy_s"]
+    assert taxed["wall_s"] > untaxed["wall_s"]
+
+
+def test_replay_bench_record_accepts_disaggregate_extra():
+    from torch_automatic_distributed_neural_network_tpu.tune.simulate \
+        import replay_bench_record
+
+    extra = {"streams": 8, "slots": 4, "prompt_len": 12, "max_new": 16,
+             "block_size": 8, "max_len": 64, "prefill_chunk": 32,
+             "new_tokens": 120, "disaggregate": True,
+             "breakdown": {"decode_step_ms": 2.0,
+                           "prefill_chunk_ms": 2.0}}
+    rep = replay_bench_record(extra)
+    assert rep["disaggregate"] is True
+    assert rep["n_finished"] == 8
+    assert rep["new_tokens"] == 120
+    assert rep["kv_ships"] >= 8  # every stream shipped at least once
+
+
+def test_simulate_policy_disaggregate_beats_colocated(devices8):
+    """End-to-end sweep: on the same single-slice fleet the
+    disaggregated policy cannot serve fewer tok/s than colocated (the
+    step wall is max instead of sum, and nothing else changes)."""
+    import dataclasses
+
+    from torch_automatic_distributed_neural_network_tpu.tune.simulate \
+        import SimulatePolicy, simulate
+
+    model, variables = _model_and_vars()
+    abstract = jax.eval_shape(lambda: variables["params"])
+    pol = SimulatePolicy(slots=4, max_len=64, block_size=8,
+                         admissions=("reserve",), slicings=(1,),
+                         grad_accums=(1,), use_cache=False, top_k=4)
+    co = simulate(abstract, ["v5e-8"], model_cfg=model.cfg, policy=pol)
+    di = simulate(abstract, ["v5e-8"], model_cfg=model.cfg,
+                  policy=dataclasses.replace(pol, disaggregate=True))
+    tok = {p["plan"]: p["tok_s_per_chip"] for p in co["predictions"]
+           if p["tok_s_per_chip"] is not None}
+    for p in di["predictions"]:
+        if p["tok_s_per_chip"] is not None and p["plan"] in tok:
+            assert p["tok_s_per_chip"] >= tok[p["plan"]] - 1e-6

@@ -74,15 +74,18 @@ def serve_estimate(cfg, *,
     """(findings, estimate) for a serving deployment of ``cfg``.
 
     ``params_bytes`` is charged replicated (the latency-first serving
-    layout); ``degrees`` shards only the KV pool's head axis, matching
-    ``cache_partition_spec``.  ``streams`` is the requested concurrency
+    layout); ``degrees`` shards the KV pool's head axis (matching
+    ``cache_partition_spec``) and the adapter pool's b factors, so
+    stream caps recompute from per-shard HBM.  ``streams`` is the requested concurrency
     — when given, fitting fewer is an ML005 warning.
 
     ``adapters`` sizes a multi-tenant LoRA pool (slot 0, the identity
     adapter, is counted on top — the pool the engine builds holds
-    ``adapters + 1`` entries), charged replicated like the params via
-    ``pool_adapter_bytes`` (default q+v recipe at ``adapter_rank``,
-    int8 payload + fp32 scales when ``quant_adapters``).  When that
+    ``adapters + 1`` entries), charged per shard via
+    ``pool_adapter_bytes(degrees=...)`` (default q+v recipe at
+    ``adapter_rank``, int8 payload + fp32 scales when
+    ``quant_adapters``; b factors split over the tensor degree exactly
+    as AdapterPool shards them).  When that
     term alone turns a >=1-stream deployment into a 0-stream one, the
     finding is ML006, not ML004 — the fix is a smaller/int8 adapter
     pool, not a smaller KV pool.
@@ -111,10 +114,14 @@ def serve_estimate(cfg, *,
     if adapters:
         from ..inference.serve.adapters import pool_adapter_bytes
 
-        # +1: the engine's pool reserves slot 0 for the identity adapter
+        # +1: the engine's pool reserves slot 0 for the identity
+        # adapter.  Charged PER SHARD: under a tensor degree the
+        # AdapterPool splits each b factor's output channels, so only
+        # b/t lands on the device being budgeted (a deployment that
+        # fits sharded must not be rejected from replicated arithmetic)
         adapter_pool_bytes = pool_adapter_bytes(
             cfg, rank=adapter_rank, n_adapters=int(adapters) + 1,
-            quantize=quant_adapters)
+            quantize=quant_adapters, degrees=degrees)
 
     usable = (int(budget_bytes * (1.0 - headroom)) - int(params_bytes)
               - adapter_pool_bytes)

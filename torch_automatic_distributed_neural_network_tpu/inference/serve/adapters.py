@@ -191,13 +191,21 @@ class AdapterPool:
     it into a device slot (loading on fault); ``release()`` unpins.
     ``factors`` is the pytree the jitted decode step consumes — its
     structure and shapes never change after construction, so slot loads
-    (functional ``.at[:, slot].set``) never retrace.  Replicated across
-    devices (factors are rank-r small; sharding them would cost more in
-    collectives than it saves).
+    (functional ``.at[:, slot].set``) never retrace.
+
+    Sharding: with a ``mesh``, each site's ``b`` factor ([L, A, r,
+    d_out]) splits its output channels over the tensor axis when they
+    divide — the same split the projection weight itself carries under
+    TP, so the per-shard delta composes with the per-shard matmul
+    without any extra collective (the o_proj all-reduce that already
+    exists covers it).  ``a`` stays replicated: its output dim is the
+    rank, far below any useful shard count.  Without a mesh (or when
+    d_out doesn't divide) everything is replicated, the pre-TP
+    behavior.
     """
 
     def __init__(self, base_params, spec: LoraSpec, *, n_adapters: int = 8,
-                 quantize: bool = False, dtype=jnp.float32):
+                 quantize: bool = False, dtype=jnp.float32, mesh=None):
         self.spec = spec
         self.n_adapters = int(n_adapters)
         self.quantize = bool(quantize)
@@ -228,6 +236,41 @@ class AdapterPool:
                                    self.quantize, dtype),
             }
         self._registry: dict[str, dict] = {}
+        self.mesh = mesh
+        # key -> {"a": NamedSharding|None, "b": ...}; None = leave the
+        # factor wherever jax puts it (single device / replicated)
+        self._shardings: dict[str, dict] = {}
+        if mesh is not None:
+            from ...ops.paged_attention import tensor_degree
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            t = tensor_degree(mesh)
+            for key, (_, _, _, d_out) in self.sites.items():
+                b_spec = (P(None, None, None, "tensor")
+                          if t > 1 and d_out % t == 0 else P())
+                # one spec per factor; for int8 leaves it acts as a
+                # pytree prefix over {"q", "scale"} — the scale's
+                # [L, A, 1, d_out] last dim splits identically
+                self._shardings[key] = {
+                    "a": NamedSharding(mesh, P()),
+                    "b": NamedSharding(mesh, b_spec),
+                }
+            self._place_all()
+
+    def _place(self, key: str, side: str, leaf):
+        sh = self._shardings.get(key, {}).get(side)
+        if sh is None:
+            return leaf
+        if is_quantized_leaf(leaf):
+            return {"q": jax.device_put(leaf["q"], sh),
+                    "scale": jax.device_put(leaf["scale"], sh)}
+        return jax.device_put(leaf, sh)
+
+    def _place_all(self) -> None:
+        for key, pool in self.factors.items():
+            for side in ("a", "b"):
+                pool[side] = self._place(key, side, pool[side])
 
     # -- host registry ----------------------------------------------------
 
@@ -338,13 +381,15 @@ class AdapterPool:
             for side in ("a", "b"):
                 host, leaf = fac[side], pool[side]
                 if self.quantize:
-                    pool[side] = {
+                    loaded = {
                         "q": leaf["q"].at[:, slot].set(host["q"]),
                         "scale": leaf["scale"].at[:, slot].set(
                             host["scale"]),
                     }
                 else:
-                    pool[side] = leaf.at[:, slot].set(host)
+                    loaded = leaf.at[:, slot].set(host)
+                # re-pin the sharding the .at[].set may have dropped
+                pool[side] = self._place(key, side, loaded)
 
     # -- accounting --------------------------------------------------------
 
@@ -355,19 +400,27 @@ class AdapterPool:
 
 
 def pool_adapter_bytes(cfg, *, rank: int, n_adapters: int,
-                       quantize: bool = False) -> int:
-    """Device-free HBM cost of an AdapterPool under the DEFAULT LoraSpec
-    recipe (q_proj + v_proj) — the serve_estimate term.  fp32 factors,
-    or int8 payload + per-out-channel fp32 scales when ``quantize``."""
+                       quantize: bool = False,
+                       degrees: dict | None = None) -> int:
+    """Device-free PER-DEVICE HBM cost of an AdapterPool under the
+    DEFAULT LoraSpec recipe (q_proj + v_proj) — the serve_estimate term.
+    fp32 factors, or int8 payload + per-out-channel fp32 scales when
+    ``quantize``.  Under a tensor degree (``degrees={"tensor": t}``)
+    each ``b`` factor splits its output channels t ways when they
+    divide (AdapterPool's sharding rule), so only b/t lands on a
+    shard; ``a`` factors stay replicated."""
+    t = int((degrees or {}).get("tensor", 1)) or 1
     per_adapter_layer = 0
     q_out = cfg.n_heads * cfg.head_dim
     v_out = cfg.kv_heads * cfg.head_dim
     for d_out in (q_out, v_out):
+        shard = t if t > 1 and d_out % t == 0 else 1
         a_elems = cfg.d_model * rank
-        b_elems = rank * d_out
+        b_elems = rank * (d_out // shard)
+        o_local = d_out // shard
         if quantize:
             per_adapter_layer += a_elems + 4 * rank      # int8 + [1, r] f32
-            per_adapter_layer += b_elems + 4 * d_out     # int8 + [1, o] f32
+            per_adapter_layer += b_elems + 4 * o_local   # int8 + [1, o] f32
         else:
             per_adapter_layer += 4 * (a_elems + b_elems)
     return int(cfg.n_layers) * int(n_adapters) * per_adapter_layer

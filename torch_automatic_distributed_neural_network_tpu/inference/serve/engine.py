@@ -219,10 +219,11 @@ def _paged_decode_step(params, kv, tables, ctx_lens, tok, active,
             v_layer = write_token(v_layer, tables, ctx_lens + t, v[:, t])
         if attention_impl == "paged" and T == 1:
             # fused path: block table consumed in-kernel, same ctx/window
-            # mask semantics, no [S, max_len] gather
+            # mask semantics, no [S, max_len] gather; with a mesh the
+            # kernel shard_maps over the tensor axis (kv-head parallel)
             o = paged_attention(
                 q[:, 0], k_layer, v_layer, tables, ctx_lens,
-                window=cfg.sliding_window)[:, None]
+                window=cfg.sliding_window, mesh=mesh)[:, None]
         else:
             kd = gather_blocks(k_layer, tables, dtype)
             vd = gather_blocks(v_layer, tables, dtype)
@@ -340,6 +341,7 @@ class ServeEngine:
                  quant_adapters: bool = False,
                  speculative: int = 0,
                  mesh=None,
+                 disaggregate: bool = False,
                  rng: jax.Array | None = None,
                  journal: Any = None):
         if attention_impl not in ("paged", "dense"):
@@ -371,6 +373,14 @@ class ServeEngine:
         self.prefill_chunk = prefill_chunk
         self.prefill_chunks_per_step = max(1, int(prefill_chunks_per_step))
         self.mesh = mesh
+        # disaggregated mode: prefill runs on its own mesh slice, so a
+        # step's prefill chunks don't serialize with decode — every
+        # prefilling slot advances each step (no chunks-per-step cap),
+        # finished KV ships through pool.ship_prefill, and the step's
+        # modeled wall time is max(prefill, decode) instead of the sum.
+        # Token-identical to colocated: the phases touch disjoint state
+        # (temp caches vs the pool), so only the time model changes.
+        self.disaggregate = bool(disaggregate)
         self.max_blocks = blocks_for_tokens(max_len, block_size)
         if num_blocks is None:
             # worst case every slot full-length, plus the null block
@@ -383,7 +393,7 @@ class ServeEngine:
         if lora_spec is not None:
             self.adapter_pool = AdapterPool(
                 self.params, lora_spec, n_adapters=n_adapters,
-                quantize=quant_adapters)
+                quantize=quant_adapters, mesh=mesh)
         self.scheduler = Scheduler(
             n_slots=n_slots, allocator=self.pool.allocator,
             block_size=block_size, admission=admission,
@@ -393,6 +403,12 @@ class ServeEngine:
         self._rng = jax.random.key(0) if rng is None else rng
         self._step_count = 0
         self._occupancy_sum = 0.0
+        # per-phase busy time, the bench's per-slice breakdown: what
+        # each slice spent working, and what the steps would cost
+        # end-to-end under the disaggregated overlap model
+        self.prefill_busy_s = 0.0
+        self.decode_busy_s = 0.0
+        self.overlapped_wall_s = 0.0
         self.spec_drafted = 0   # lifetime draft-token counters (k > 0)
         self.spec_accepted = 0
         self.finished: list[Request] = []
@@ -412,6 +428,8 @@ class ServeEngine:
                 partial(_prefill_chunk_lora_step, cfg=self.cfg,
                         moe_decode=moe_decode, lora_spec=lora_spec))
         if self.journal is not None:
+            from ...ops.paged_attention import tensor_degree
+
             self.journal.event(
                 "serve.engine", attention_impl=attention_impl,
                 prefill_chunk=self.prefill_chunk,
@@ -420,7 +438,9 @@ class ServeEngine:
                 n_adapters=(n_adapters if lora_spec else 0),
                 adapter_rank=(lora_spec.rank if lora_spec else None),
                 quant_adapters=bool(quant_adapters and lora_spec),
-                speculative=self.speculative)
+                speculative=self.speculative,
+                disaggregate=self.disaggregate,
+                tp=tensor_degree(mesh))
 
     # -- request intake ------------------------------------------------------
 
@@ -501,6 +521,26 @@ class ServeEngine:
             return None
         return self.adapter_pool.effective_lora(req.adapter)
 
+    def _commit_prefill(self, slot: int, req: Request,
+                        k: jax.Array, v: jax.Array) -> None:
+        """Land a finished prefill's dense cache rows in the request's
+        blocks.  Colocated mode writes in place; disaggregated mode
+        routes through ``pool.ship_prefill`` — same payload, plus the
+        block/byte transfer accounting that becomes DCN traffic when
+        the prefill slice is a distinct pod slice — and journals the
+        shipment."""
+        blocks = req.blocks[:blocks_for_tokens(
+            req.n_prompt, self.pool.block_size)]
+        if not self.disaggregate:
+            self.pool.write_prefill(blocks, k, v)
+            return
+        moved = self.pool.ship_prefill(blocks, k, v)
+        self.scheduler.record_ship(slot, len(blocks))
+        if self.journal is not None:
+            self.journal.event(
+                "serve.kv_ship", rid=req.rid, slot=slot,
+                n_blocks=len(blocks), bytes=moved)
+
     def _prefill_into_slot(self, slot: int, req: Request) -> None:
         tokens = jnp.asarray(req.prompt, jnp.int32)[None, :]
         cache = KVCache.init(self.cfg, 1, tokens.shape[1],
@@ -517,9 +557,7 @@ class ServeEngine:
         _, first_rng = jax.random.split(req_rng)
         first = int(jax.device_get(
             _sample(logits, first_rng, self.sample))[0])
-        self.pool.write_prefill(req.blocks[:blocks_for_tokens(
-            req.n_prompt, self.pool.block_size)],
-            cache.k[:, 0], cache.v[:, 0])
+        self._commit_prefill(slot, req, cache.k[:, 0], cache.v[:, 0])
         req.out_tokens = [first]
         req.t_first_token = time.monotonic()
 
@@ -566,11 +604,9 @@ class ServeEngine:
             _, first_rng = jax.random.split(req_rng)
             first = int(jax.device_get(
                 _sample(logits, first_rng, self.sample))[0])
-            self.pool.write_prefill(
-                req.blocks[:blocks_for_tokens(
-                    req.n_prompt, self.pool.block_size)],
-                st.cache.k[:, 0, :req.n_prompt],
-                st.cache.v[:, 0, :req.n_prompt])
+            self._commit_prefill(slot, req,
+                                 st.cache.k[:, 0, :req.n_prompt],
+                                 st.cache.v[:, 0, :req.n_prompt])
             req.out_tokens = [first]
             req.t_first_token = time.monotonic()
             req.state = "running"
@@ -670,9 +706,13 @@ class ServeEngine:
     def step(self) -> None:
         """One serving iteration: evict finished, admit queued, advance
         prefill chunks, grow/preempt (optimistic), decode every
-        decoding slot.  Prefill chunks INTERLEAVE with decode steps —
-        a long prompt costs each iteration one bounded chunk instead of
-        stalling the whole batch for its full prefill."""
+        decoding slot.  Colocated (default): prefill chunks INTERLEAVE
+        with decode steps — at most ``prefill_chunks_per_step`` per
+        iteration, their time serializing with decode on the one chip.
+        Disaggregated: EVERY prefilling slot advances each step (the
+        prefill slice has nothing else to do) and the step's modeled
+        wall time is ``max(prefill, decode)`` — the slices run
+        concurrently, only the KV-block shipment couples them."""
         sched = self.scheduler
         for s in range(self.n_slots):
             req = sched.slots[s]
@@ -684,7 +724,8 @@ class ServeEngine:
             if req.state == "running" and req.finished():
                 self._finish(slot)  # single-shot, max_new_tokens == 1
         prefill_s = 0.0
-        for slot, req in sched.prefill_plan(self.prefill_chunks_per_step):
+        budget = None if self.disaggregate else self.prefill_chunks_per_step
+        for slot, req in sched.prefill_plan(budget):
             t0 = time.monotonic()
             self._advance_prefill(slot, req)
             prefill_s += time.monotonic() - t0
@@ -702,6 +743,13 @@ class ServeEngine:
             decode_s = time.monotonic() - t0
         self._step_count += 1
         self._occupancy_sum += sched.n_active / self.n_slots
+        self.prefill_busy_s += prefill_s
+        self.decode_busy_s += decode_s
+        # the step's cost under this mode's concurrency model: one chip
+        # serializes the phases; distinct slices overlap them
+        overlap_s = (max(prefill_s, decode_s) if self.disaggregate
+                     else prefill_s + decode_s)
+        self.overlapped_wall_s += overlap_s
         if self.journal is not None:
             adapter_stats = {}
             if self.adapter_pool is not None:
@@ -716,6 +764,9 @@ class ServeEngine:
                 occupancy=sched.n_active / self.n_slots,
                 free_blocks=self.pool.allocator.n_free,
                 prefill_s=prefill_s, decode_s=decode_s,
+                mode=("disaggregated" if self.disaggregate
+                      else "colocated"),
+                overlap_s=overlap_s,
                 **adapter_stats)
 
     @property

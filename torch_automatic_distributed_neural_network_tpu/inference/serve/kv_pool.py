@@ -202,6 +202,11 @@ class PagedKVPool:
         self.dtype = dtype
         self.quantize = bool(quantize)
         self.allocator = BlockAllocator(num_blocks)
+        # prefill->decode block-transfer accounting (disaggregated
+        # serving ships finished prefill KV through ship_prefill)
+        self.n_transfers = 0
+        self.transferred_blocks = 0
+        self.transferred_bytes = 0
         shape = (cfg.n_layers, num_blocks, block_size,
                  cfg.kv_heads, cfg.head_dim)
         self.kv = {"k": _zeros_side(shape, dtype, quantize),
@@ -230,6 +235,14 @@ class PagedKVPool:
     @property
     def total_bytes(self) -> int:
         return pool_kv_bytes(self.cfg, self.num_blocks, self.block_size,
+                             self.dtype, self.quantize)
+
+    @property
+    def bytes_per_block(self) -> int:
+        """Global bytes one block id holds across all layers, k and v
+        (scales included in int8 mode) — the unit the block-transfer
+        accounting charges per shipped block."""
+        return pool_kv_bytes(self.cfg, 1, self.block_size,
                              self.dtype, self.quantize)
 
     def alloc(self, n: int) -> list[int] | None:
@@ -276,3 +289,20 @@ class PagedKVPool:
             else:
                 self.kv[side] = leaf.at[:, idx].set(
                     view.astype(leaf.dtype))
+
+    def ship_prefill(self, blocks: list[int], k: jax.Array,
+                     v: jax.Array) -> int:
+        """``write_prefill`` plus block-transfer accounting — the
+        disaggregated engine's path for handing a finished prefill's KV
+        to the decode slice.  The payload is the same either way (the
+        pool write IS the transfer when both slices share one process);
+        what this adds is the metric: blocks and bytes shipped at pool
+        storage precision, i.e. what crosses the wire when prefill and
+        decode live on distinct mesh slices.  Returns the bytes moved.
+        """
+        self.write_prefill(blocks, k, v)
+        moved = len(blocks) * self.bytes_per_block
+        self.n_transfers += 1
+        self.transferred_blocks += len(blocks)
+        self.transferred_bytes += moved
+        return moved

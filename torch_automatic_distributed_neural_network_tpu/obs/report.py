@@ -328,7 +328,19 @@ def generate(target: str, metrics_path: str | None = None) -> dict:
                                           for e in schunks),
             "attention_impl": (sengine or {}).get("attention_impl"),
             "prefill_chunk": (sengine or {}).get("prefill_chunk"),
+            # disaggregated / sharded serving (r04 fields)
+            "mode": (ssteps[-1].get("mode") if ssteps else None),
+            "tp": (sengine or {}).get("tp"),
+            "overlapped_wall_s": (sum(_finite(
+                e.get("overlap_s") for e in ssteps)) or None),
         }
+        ships = [e for e in events if e.get("name") == "serve.kv_ship"]
+        if ships:
+            serving["kv_ships"] = len(ships)
+            serving["shipped_blocks"] = int(sum(
+                _finite(e.get("n_blocks") for e in ships)))
+            serving["shipped_bytes"] = int(sum(
+                _finite(e.get("bytes") for e in ships)))
         spec = [e for e in events if e.get("name") == "serve.speculate"]
         if spec:
             drafted = sum(_finite(e.get("drafted") for e in spec))
@@ -687,6 +699,19 @@ def format_report(report: dict) -> str:
                    if sv.get("prefill_chunk") else ""))
         if bparts:
             lines.append("  " + "  ".join(bparts))
+        if sv.get("mode") == "disaggregated" or (sv.get("tp") or 1) > 1:
+            dparts = [f"mode {sv.get('mode') or 'colocated'}"]
+            if (sv.get("tp") or 1) > 1:
+                dparts.append(f"tp {sv['tp']}")
+            if sv.get("overlapped_wall_s") is not None:
+                dparts.append(
+                    f"overlapped wall {sv['overlapped_wall_s']:.2f}s")
+            if sv.get("kv_ships"):
+                dparts.append(
+                    f"kv ships {sv['kv_ships']} "
+                    f"({sv.get('shipped_blocks', 0)} block(s), "
+                    f"{sv.get('shipped_bytes', 0) / 1024:.0f} KiB)")
+            lines.append("  " + "  ".join(dparts))
         if sv.get("spec_rounds"):
             rate = sv.get("spec_accept_rate")
             lines.append(

@@ -140,6 +140,13 @@ def _decode_kernel(*refs, cfg: _Cfg, scale: float):
         o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
 
 
+def tensor_degree(mesh, axis: str = "tensor") -> int:
+    """Size of ``axis`` in ``mesh`` (1 when absent or mesh is None)."""
+    if mesh is None:
+        return 1
+    return dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis, 1)
+
+
 def paged_attention(
     q: jax.Array,
     k_pool,
@@ -149,6 +156,8 @@ def paged_attention(
     *,
     window: int | None = None,
     interpret: bool | None = None,
+    mesh=None,
+    axis: str = "tensor",
 ) -> jax.Array:
     """Fused paged decode attention over one layer of the KV pool.
 
@@ -159,11 +168,57 @@ def paged_attention(
     decode-step convention: this step's key was just written at ``ctx``).
     Returns [S, Hq, hd] in ``q.dtype``.  The dense gathered view is never
     materialized — block pages stream VMEM-ward via the table prefetch.
+
+    With ``mesh``, kv heads are partitioned over its ``axis`` (the
+    ``cache_partition_spec`` rule: only when the head count divides the
+    degree): the kernel runs per-shard under ``shard_map``, each device
+    holding its head slice of the pool and computing its query group's
+    attention — one server's pool HBM and attention FLOPs span the
+    axis.  Heads are kv-major (``q.reshape(S, kvH, G, hd)``), so an
+    even head split keeps every GQA group intact on one shard and the
+    result needs no cross-device combine (attention is head-parallel).
+    Tables and context lengths stay replicated — any slot may reference
+    any block, exactly like the unsharded pool.
     """
     from ..inference.quant import kv_leaf_parts
 
     if interpret is None:
         interpret = _default_interpret()
+    t = tensor_degree(mesh, axis)
+    kvH_full = kv_leaf_parts(k_pool)[0].shape[2]
+    if t > 1 and kvH_full % t == 0:
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        heads = P(None, axis, None)        # [S, Hq, hd] on the head axis
+        pool = P(None, None, axis, None)   # [NB, bs, kvH, hd] (+scales)
+        local = functools.partial(
+            _paged_attention_local, window=window, interpret=interpret)
+        return shard_map(
+            local, mesh=mesh,
+            in_specs=(heads, pool, pool, P(None, None), P(None)),
+            out_specs=heads, check_rep=False,
+        )(q, k_pool, v_pool, tables, ctx_lens)
+    return _paged_attention_local(
+        q, k_pool, v_pool, tables, ctx_lens,
+        window=window, interpret=interpret)
+
+
+def _paged_attention_local(
+    q: jax.Array,
+    k_pool,
+    v_pool,
+    tables: jax.Array,
+    ctx_lens: jax.Array,
+    *,
+    window: int | None,
+    interpret: bool,
+) -> jax.Array:
+    """One device's (or the whole unsharded) kernel invocation — under
+    ``shard_map`` the head axes arrive pre-sliced and the block tables
+    replicated, so the body is identical either way."""
+    from ..inference.quant import kv_leaf_parts
+
     k_arr, k_scale = kv_leaf_parts(k_pool)
     v_arr, v_scale = kv_leaf_parts(v_pool)
     quantized = k_scale is not None

@@ -136,6 +136,9 @@ def replay_serve(
     spec_lookahead: int = 0,
     decode_step_s: float = 1e-3,
     prefill_chunk_s: float = 1e-3,
+    disaggregate: bool = False,
+    kv_ship_s: float = 0.0,
+    dcn_step_s: float = 0.0,
     max_steps: int = 200_000,
 ) -> dict:
     """Discrete-event replay of the serving scheduler on virtual time.
@@ -149,6 +152,16 @@ def replay_serve(
     *timing* comes from the supplied per-step costs, so the output is
     the policy's admission/preemption/occupancy behavior priced in
     seconds.
+
+    ``disaggregate`` mirrors the engine's split-slice mode: every
+    prefilling slot advances each step (no chunks-per-step cap), each
+    finished prefill pays ``kv_ship_s`` to hand its KV blocks to the
+    decode slice (``Scheduler.record_ship`` accounting, same counters
+    the live engine accrues), and a step's wall time is
+    ``max(prefill_side, decode_side)`` — the slices run concurrently —
+    instead of their sum.  ``dcn_step_s`` prices per-decode-step
+    cross-slice collectives (a tp group spanning slices); it is added
+    on the decode side in both modes.
     """
     clock = [0.0]
     if num_blocks is None:
@@ -176,6 +189,8 @@ def replay_serve(
 
     steps = 0
     occ_sum = 0.0
+    prefill_busy = 0.0
+    decode_busy = 0.0
     while steps < max_steps:
         # arrivals due by now join the queue (bench-style all-up-front
         # submission is just every arrival at t=0)
@@ -199,26 +214,40 @@ def replay_serve(
                     and req.finished()):
                 done.append(sched.evict(s))
                 progressed = True
-        step_s = 0.0
+        step_pf_s = 0.0
+        step_dec_s = 0.0
+
+        def ship(slot: int, req: Request) -> float:
+            # disaggregated: finished prefill pays the block handoff
+            # into the decode slice (engine: pool.ship_prefill)
+            if not disaggregate:
+                return 0.0
+            sched.record_ship(
+                slot, blocks_for_tokens(req.n_prompt, block_size))
+            return kv_ship_s
+
         for slot, req in sched.admit():
             progressed = True
             if chunk is None:
+                step_pf_s += prefill_chunk_s  # one full prompt forward
+                step_pf_s += ship(slot, req)
                 emit(req)  # single-shot prefill: first token now
                 req.t_first_token = clock[0]
-                step_s += prefill_chunk_s  # one full prompt forward
                 if req.finished():
                     done.append(sched.evict(slot))
             else:
                 req.state = "prefilling"
                 prefill_pos[req.rid] = 0
-        for slot, req in sched.prefill_plan(prefill_chunks_per_step):
+        budget = None if disaggregate else prefill_chunks_per_step
+        for slot, req in sched.prefill_plan(budget):
             pos = prefill_pos[req.rid]
             pos += min(chunk, req.n_prompt - pos)
             prefill_pos[req.rid] = pos
-            step_s += prefill_chunk_s
+            step_pf_s += prefill_chunk_s
             progressed = True
             if pos >= req.n_prompt:
                 del prefill_pos[req.rid]
+                step_pf_s += ship(slot, req)
                 emit(req)
                 req.t_first_token = clock[0]
                 req.state = "running"
@@ -231,10 +260,15 @@ def replay_serve(
             for req in sched.slots:
                 if req is not None and req.state == "running":
                     emit(req)
-            step_s += decode_step_s
+            step_dec_s += decode_step_s + dcn_step_s
             progressed = True
         steps += 1
         occ_sum += sched.n_active / n_slots
+        prefill_busy += step_pf_s
+        decode_busy += step_dec_s
+        # one chip serializes the phases; distinct slices overlap them
+        step_s = (max(step_pf_s, step_dec_s) if disaggregate
+                  else step_pf_s + step_dec_s)
         clock[0] += step_s
 
         if not progressed:
@@ -258,6 +292,11 @@ def replay_serve(
         "tokens_per_s": (new_tokens / wall) if wall > 0 else 0.0,
         "mean_occupancy": (occ_sum / steps) if steps else 0.0,
         "preemptions": int(sched.n_preemptions),
+        "disaggregate": bool(disaggregate),
+        "prefill_busy_s": prefill_busy,
+        "decode_busy_s": decode_busy,
+        "kv_ships": int(sched.n_kv_ships),
+        "shipped_blocks": int(sched.shipped_blocks),
         "p50_s": float(np.percentile(totals, 50)) if totals else None,
         "p99_s": float(np.percentile(totals, 99)) if totals else None,
         "p99_admission_wait_s": (float(np.percentile(waits, 99))
@@ -297,6 +336,9 @@ def replay_bench_record(extra: Mapping[str, Any]) -> dict:
         spec_lookahead=int(extra.get("speculative") or 0),
         decode_step_s=float(bd.get("decode_step_ms") or 1.0) * 1e-3,
         prefill_chunk_s=float(bd.get("prefill_chunk_ms") or 1.0) * 1e-3,
+        # r04+ records carry the engine mode; the in-process bench ships
+        # blocks at HBM speed, so no extra kv_ship_s term here
+        disaggregate=bool(extra.get("disaggregate")),
     )
     obs_journal.event("simulate.replay", source="bench_record", **{
         k: result[k] for k in ("steps", "new_tokens", "tokens_per_s",
@@ -317,6 +359,9 @@ class SimulatePolicy:
     batch_items: int | None = None
     safety: float = space_mod.MEMORY_SAFETY
     zero1: bool = True
+    # measured comm/compute overlap (0..1) correcting the training
+    # roofline — from `tadnn trace` via cost.overlap_from_trace, wired
+    # through `tadnn simulate --trace-journal` / --measured-overlap
     measured_overlap: float | None = None
     # topology expansion: an un-sliced SKU ("v5p-16") is swept over
     # these slice counts (kept where they divide the chip count)
@@ -328,6 +373,10 @@ class SimulatePolicy:
     max_len: int = 256
     prefill_chunk: int | None = 32
     spec_lookahead: int = 0
+    # disaggregated prefill/decode serving replicas (engine
+    # --disaggregate): prefill on its own slice, KV blocks shipped over
+    # DCN on multislice fleets, step wall = max(prefill, decode)
+    disaggregate: bool = False
     quant_kv: bool = False
     adapters: int = 0
     adapter_rank: int = 8
@@ -510,15 +559,15 @@ def simulate(
                 }
                 if serve_est is not None and serve_est["max_streams"] > 0:
                     slots = min(policy.slots, serve_est["max_streams"])
+                    kv_tok = (2 * model_cfg.n_layers
+                              * model_cfg.kv_heads
+                              * model_cfg.head_dim
+                              * (1 if policy.quant_kv else 2))
                     if policy.decode_step_ms is not None:
                         dec_s = policy.decode_step_ms * 1e-3
                         pf_s = (policy.prefill_chunk_ms
                                 or policy.decode_step_ms) * 1e-3
                     else:
-                        kv_tok = (2 * model_cfg.n_layers
-                                  * model_cfg.kv_heads
-                                  * model_cfg.head_dim
-                                  * (1 if policy.quant_kv else 2))
                         dec_s, pf_s = _serving_times(
                             chip, params_bytes=params_bytes,
                             kv_bytes_per_step=(kv_tok * slots
@@ -529,8 +578,33 @@ def simulate(
                                 * (policy.prefill_chunk or
                                    traffic.prompt_mean)),
                             tensor=tensor)
+                    # multi-slice serving tax (measured step costs came
+                    # from single-slice runs, so these apply either way):
+                    # a tp group wider than one slice pays two DCN
+                    # all-reduces of the [slots, d_model] activations
+                    # per layer per decode step
+                    dcn_s = 0.0
+                    ship_s = 0.0
+                    if topo.is_multislice:
+                        d = getattr(model_cfg, "d_model",
+                                    model_cfg.kv_heads
+                                    * model_cfg.head_dim)
+                        if tensor > topo.devices_per_slice:
+                            step_bytes = (2 * model_cfg.n_layers
+                                          * slots * d * 2)
+                            dcn_s = (step_bytes / chip.dcn_bytes_per_s
+                                     + 2 * model_cfg.n_layers
+                                     * chip.dcn_latency_s)
+                        if policy.disaggregate:
+                            # a finished prompt's KV crosses slices
+                            ship_s = (kv_tok * traffic.prompt_mean
+                                      / max(1, tensor)
+                                      / chip.dcn_bytes_per_s
+                                      + chip.dcn_latency_s)
                     rk = (adm, slots, serve_est["num_blocks"],
-                          round(dec_s, 9), round(pf_s, 9))
+                          round(dec_s, 9), round(pf_s, 9),
+                          policy.disaggregate,
+                          round(ship_s, 9), round(dcn_s, 9))
                     if rk not in replay_memo:
                         replay_memo[rk] = replay_serve(
                             requests, n_slots=slots,
@@ -540,14 +614,19 @@ def simulate(
                             admission=adm,
                             prefill_chunk=policy.prefill_chunk,
                             spec_lookahead=policy.spec_lookahead,
-                            decode_step_s=dec_s, prefill_chunk_s=pf_s)
+                            decode_step_s=dec_s, prefill_chunk_s=pf_s,
+                            disaggregate=policy.disaggregate,
+                            kv_ship_s=ship_s, dcn_step_s=dcn_s)
                         obs_journal.event(
                             "simulate.replay", admission=adm,
                             slots=slots, decode_step_ms=dec_s * 1e3,
+                            disaggregate=policy.disaggregate,
+                            dcn_step_ms=dcn_s * 1e3,
+                            kv_ship_ms=ship_s * 1e3,
                             **{k: replay_memo[rk][k] for k in
                                ("steps", "tokens_per_s",
                                 "mean_occupancy", "preemptions",
-                                "stalled")})
+                                "stalled", "kv_ships")})
                     rep = replay_memo[rk]
                     pred.update(
                         tok_s_per_chip=round(
