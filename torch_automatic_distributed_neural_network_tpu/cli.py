@@ -661,6 +661,7 @@ def cmd_launch(args: argparse.Namespace) -> int:
             max_restarts=args.max_restarts, elastic=args.elastic,
             watchdog_s=args.watchdog_s, chaos=chaos_plan,
             heartbeat_interval_s=args.heartbeat_interval_s,
+            export_cache=getattr(args, "export_cache", None),
         )
 
     if args.smoke:
@@ -1047,6 +1048,172 @@ def cmd_serve(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_export(args: argparse.Namespace) -> int:
+    """AOT export (export/ subsystem): compile the training step —
+    and, with ``--serve``, the serving decode/prefill traces — ahead of
+    time, serialize the executables into the content-addressed export
+    cache, and print one result line per executable.  Any later
+    ``Trainer``/``ServeEngine`` start on the same fingerprint (same
+    shapes, plan, topology, jax/XLA version) then deserializes in
+    milliseconds instead of recompiling.  ``--worlds N,M`` prewarms
+    simulated N-device topologies in subprocesses (the elastic
+    launcher's shrink candidates); ``--verify`` audits which cache
+    entries would load here/now and which are stale."""
+    from .export import cache as export_cache_mod
+    from .obs import journal as obs_journal_mod
+
+    cache = export_cache_mod.resolve(args.cache or True)
+
+    if args.verify:
+        report = cache.verify()
+        if args.json:
+            print(json.dumps({"cache": cache.root, "entries": report}))
+        else:
+            print(f"export cache: {cache.root}")
+            if not report:
+                print("  (empty)")
+            for e in report:
+                mark = "live " if e["live"] else "STALE"
+                kb = (e.get("payload_bytes") or 0) // 1024
+                line = (f"  [{mark}] {e.get('kind') or '?':<14} "
+                        f"{e['key'][:16]}  {kb} KiB")
+                if e.get("reason"):
+                    line += f"  ({e['reason']})"
+                print(line)
+        return 0
+
+    if args.worlds:
+        # fan out over simulated device counts: each child exports the
+        # same spec on an N-device CPU mesh, landing N-keyed entries in
+        # the shared cache — exactly what an elastic shrink will ask for
+        import subprocess
+
+        from .training.launch import _sim_env
+
+        worlds = [int(w) for w in args.worlds.split(",") if w.strip()]
+        base = [sys.executable, "-m",
+                "torch_automatic_distributed_neural_network_tpu", "export",
+                "--family", args.family, "--batch", str(args.batch),
+                "--strategy", args.strategy,
+                "--precision", args.precision,
+                "--cache", cache.root, "--json"]
+        if args.size:
+            base += ["--size", args.size]
+        if args.seq:
+            base += ["--seq", str(args.seq)]
+        if args.serve:
+            base.append("--serve")
+        ok = True
+        for w in worlds:
+            env = _sim_env(w)
+            env["TADNN_EXPORT_CACHE"] = cache.root
+            proc = subprocess.run(base, env=env, capture_output=True,
+                                  text=True)
+            if proc.returncode != 0:
+                ok = False
+                print(json.dumps({"world": w, "error": "export failed",
+                                  "rc": proc.returncode,
+                                  "stderr": proc.stderr[-500:]}))
+                continue
+            for line in proc.stdout.splitlines():
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                rec["world"] = w
+                print(json.dumps(rec))
+        return 0 if ok else 1
+
+    import jax
+    import optax
+
+    from . import AutoDistribute
+    from .obs.journal import Journal
+
+    results: list[dict] = []
+    with Journal(args.journal, host0_only=False,
+                 meta={"tool": "export"}) as jnl:
+        with obs_journal_mod.as_default(jnl):
+            if args.preflight:
+                # user-authored spec: the file's tadnn_export() returns
+                # {model, loss_fn, sample_batch[, optimizer, **ad_kwargs]}
+                # — export the REAL training program, not a zoo preset
+                import importlib.util
+
+                spec = importlib.util.spec_from_file_location(
+                    "_tadnn_export_target", args.preflight)
+                mod = importlib.util.module_from_spec(spec)
+                spec.loader.exec_module(mod)
+                hook = getattr(mod, "tadnn_export", None)
+                if hook is None:
+                    print(f"{args.preflight} does not define "
+                          f"tadnn_export()", file=sys.stderr)
+                    return 2
+                d = dict(hook())
+                model = d.pop("model")
+                loss = d.pop("loss_fn")
+                sample = d.pop("sample_batch")
+                optimizer = d.pop("optimizer", None) or optax.adamw(1e-4)
+                kwargs = {"strategy": args.strategy,
+                          "precision": args.precision}
+                kwargs.update(d)
+            else:
+                model, loss, sample = _family_setup(args)
+                optimizer = optax.adamw(1e-4)
+                kwargs = {"strategy": args.strategy,
+                          "precision": args.precision}
+            ad = AutoDistribute(model, optimizer=optimizer, loss_fn=loss,
+                                grad_accum=args.grad_accum,
+                                zero1=args.zero1, **kwargs)
+            results.append(ad.export_step(jax.random.key(0), sample,
+                                          cache=cache))
+            if args.serve:
+                if args.family not in ("gpt2", "llama", "moe"):
+                    print("export --serve needs a decoder family "
+                          "(--family gpt2|llama|moe)", file=sys.stderr)
+                    return 2
+                import jax.numpy as jnp
+
+                from .inference.serve import ServeEngine
+                from .models import GPT2, Llama, MoE
+
+                family = {"gpt2": GPT2, "llama": Llama,
+                          "moe": MoE}[args.family]
+                size = args.size or "test"
+                max_len = args.max_len or 64
+                vocab = args.vocab or (128 if size == "test" else None)
+                overrides = {"max_seq_len": max_len, "dtype": jnp.float32,
+                             "remat": False}
+                if vocab:
+                    overrides["vocab_size"] = vocab
+                smodel = family(size, **overrides)
+                variables = smodel.init(jax.random.key(1),
+                                        jnp.zeros((1, 8), jnp.int32))
+                eng = ServeEngine(
+                    smodel, variables, n_slots=args.slots or 4,
+                    max_len=max_len, block_size=args.block_size or 8,
+                    prefill_chunk=args.prefill_chunk or 32,
+                    journal=jnl, export_cache=cache)
+                results.extend(eng.export_info)
+    rc = 0
+    for r in results:
+        if r.get("source") == "error":
+            rc = 1
+        if args.json:
+            print(json.dumps(r))
+        else:
+            wall = (f"deserialized in {r['deserialize_s'] * 1e3:.1f} ms"
+                    if r.get("source") == "hit"
+                    else f"compiled in {r.get('compile_s', 0.0):.2f} s"
+                    if r.get("source") == "compile" else "FAILED")
+            kb = (r.get("payload_bytes") or 0) // 1024
+            print(f"{r.get('kind', '?'):<14} {r.get('source', '?'):<8} "
+                  f"{wall}  ({kb} KiB, key {r.get('key', '?')[:16]})")
+    if not args.json:
+        print(f"export cache: {cache.root}")
+    return rc
+
+
 def cmd_tokenize(args: argparse.Namespace) -> int:
     """Text -> TADN token file (data/text.py)."""
     from .data.text import load_tokenizer, tokenize_file
@@ -1383,6 +1550,57 @@ def main(argv: list[str] | None = None) -> int:
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser(
+        "export",
+        help="AOT-compile the train step (and --serve decode/prefill "
+             "traces) and serialize the executables into the export "
+             "cache, so later starts deserialize instead of "
+             "recompiling; --verify audits live vs stale entries",
+    )
+    p.add_argument("--family", default="gpt2",
+                   choices=("mlp", "gpt2", "llama", "moe", "bert", "vit"))
+    p.add_argument("--size", default=None,
+                   help="model size preset (default per family)")
+    p.add_argument("--seq", type=int, default=None)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--strategy", default="auto")
+    p.add_argument("--precision", default="fp32")
+    p.add_argument("--grad-accum", type=int, default=1,
+                   dest="grad_accum")
+    p.add_argument("--zero1", action="store_true")
+    p.add_argument("--loss", default="full", choices=("full", "blockwise"))
+    p.add_argument("--preflight", default=None, metavar="FILE",
+                   help="export the file's tadnn_export() spec "
+                        "({model, loss_fn, sample_batch[, optimizer, "
+                        "ad kwargs]}) instead of a --family preset")
+    p.add_argument("--serve", action="store_true",
+                   help="also export the serving decode + prefill-chunk "
+                        "traces (decoder families only)")
+    p.add_argument("--worlds", default=None, metavar="N,M,...",
+                   help="prewarm simulated N-device topologies in "
+                        "subprocesses (the elastic launcher's shrink "
+                        "candidates)")
+    p.add_argument("--cache", default=None,
+                   help="export cache dir (default: TADNN_EXPORT_CACHE "
+                        "or ~/.cache/tadnn/executables)")
+    p.add_argument("--verify", action="store_true",
+                   help="report which cache entries would load on this "
+                        "host/version (live) and which are stale")
+    p.add_argument("--slots", type=int, default=None,
+                   help="--serve: decode slots")
+    p.add_argument("--max-len", type=int, default=None, dest="max_len",
+                   help="--serve: max tokens per request")
+    p.add_argument("--block-size", type=int, default=None,
+                   dest="block_size", help="--serve: KV block size")
+    p.add_argument("--prefill-chunk", type=int, default=32,
+                   dest="prefill_chunk")
+    p.add_argument("--vocab", type=int, default=None)
+    p.add_argument("--journal", default=None,
+                   help="journal path for export.* events "
+                        "(tadnn report renders them)")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_export)
+
+    p = sub.add_parser(
         "doctor",
         help="verify a checkpoint directory (per-leaf integrity "
              "manifests, resilience.py) and print the fallback chain; "
@@ -1432,6 +1650,11 @@ def main(argv: list[str] | None = None) -> int:
                    help="partition the chaos host's journal at this "
                         "step (repeatable)")
     p.add_argument("--chaos-host", type=int, default=0)
+    p.add_argument("--export-cache", default=None, dest="export_cache",
+                   help="AOT executable cache dir shared by the cohort: "
+                        "workers go cache-first on the step compile and "
+                        "elastic shrink worlds are prewarmed in the "
+                        "background (tadnn export)")
     p.add_argument("--smoke", action="store_true",
                    help="clean + one-SIGKILL chaos pair; exit nonzero "
                         "unless resumed losses match bitwise")

@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Any, Callable, Sequence
+from typing import Any, Callable, Mapping, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -64,6 +64,37 @@ def _abstract_signature(tree: Any) -> tuple:
 
 def _signature_str(key: tuple) -> str:
     return ",".join(f"{list(s)}:{d}" for s, d in key[1])
+
+
+class _ExportedStep:
+    """Export-cache dispatch shim for the train step.
+
+    Batches matching the exported abstract signature run the AOT
+    executable directly — no jit cache, no trace, and (on a warm start)
+    no XLA compile at all.  Anything else falls through to the jit fn,
+    where ``_timed_dispatch``'s recompile accounting sees it as the
+    shape-churn recompile it is.  ``lower`` delegates to the jit fn so
+    ``compiled_step_text`` / ``compile_report`` keep working.
+    """
+
+    def __init__(self, compiled, jit_fn, batch_sig: tuple):
+        self._compiled = compiled
+        self._jit = jit_fn
+        self._batch_sig = batch_sig
+
+    def __call__(self, state, batch):
+        if (self._compiled is not None
+                and _abstract_signature(batch) == self._batch_sig):
+            try:
+                return self._compiled(state, batch)
+            except Exception as e:  # argument-check time: state not donated
+                obs_journal.event("export.fallback", fn="train_step",
+                                  error=f"{type(e).__name__}: {e}")
+                self._compiled = None
+        return self._jit(state, batch)
+
+    def lower(self, *args, **kwargs):
+        return self._jit.lower(*args, **kwargs)
 
 
 @struct.dataclass
@@ -160,6 +191,18 @@ class AutoDistribute:
         ~the data degree for the cost of swapping the grad all-reduce
         (2(n-1)/n wire) for RS+AG (2 x (n-1)/n).  No-op without a
         nontrivial data axis.
+    export_cache:
+        AOT executable cache (export/): ``init`` goes cache-first on the
+        compiled train step — a warm entry deserializes (zero XLA step
+        compiles, bitwise-identical outputs), a miss AOT-compiles and
+        serializes for the next start.  A path enables at that
+        directory, ``True`` at ``TADNN_EXPORT_CACHE`` or
+        ``~/.cache/tadnn/executables``, ``None`` (default) only when
+        ``TADNN_EXPORT_CACHE`` is set, ``False`` never.
+    export_tags:
+        Extra JSON-able fields folded into the executable cache key
+        (e.g. a config epoch) — entries with different tags never
+        collide.
     """
 
     def __init__(
@@ -184,6 +227,8 @@ class AutoDistribute:
         precision: str | precision_mod.Precision = "fp32",
         grad_accum: int = 1,
         zero1: bool = False,
+        export_cache: Any = None,
+        export_tags: Mapping | None = None,
     ):
         if model is None and init_fn is None:
             raise ValueError("Provide a model or an init_fn")
@@ -251,6 +296,13 @@ class AutoDistribute:
         self.recompile_count = 0
         self.comm_profile: dict | None = None  # planner comm estimate
         self.last_compile_error: str | None = None  # AOT lower/compile
+        # AOT executable cache (export/): a path/True enables, False
+        # disables, None defers to TADNN_EXPORT_CACHE in the environment
+        # — so launcher workers inherit cache-first startup through
+        # their env without any per-site plumbing.
+        self._export_cache_spec = export_cache
+        self._export_tags = dict(export_tags or {})
+        self._export_info: dict | None = None  # last export_step outcome
 
     # -- planning -----------------------------------------------------------
 
@@ -605,6 +657,7 @@ class AutoDistribute:
         shardings = self.state_shardings(abstract)
         state = jax.jit(make_state, out_shardings=shardings)(rng)
         self._compile_step(abstract, shardings)
+        self._maybe_export_step(abstract, shardings, sample_batch)
         return state
 
     def _make_state_fn(self, sample_batch):
@@ -646,6 +699,118 @@ class AutoDistribute:
             sample_batch,
         )
         return state_abs, batch_abs
+
+    # -- AOT export (export/): serialize the compiled step ------------------
+
+    def _export_key(self, abstract: Any, sample_batch: Any) -> str:
+        """Executable cache key: params signature x topology fingerprint
+        x everything that shapes the compiled program (plan + batch
+        signature + precision/accumulation/pipeline config)."""
+        from .export import cache as export_cache_mod
+        from .tune import cache as tune_cache
+
+        plan = self.plan
+        assert plan is not None
+        topo = topo_mod.detect(list(plan.mesh.devices.flat))
+        prec = self.precision
+        program = {
+            "plan": export_cache_mod.plan_blob(plan),
+            "batch": _signature_str(_abstract_signature(sample_batch)),
+            "grad_accum": self._grad_accum,
+            "donate": bool(self._donate),
+            "precision": [str(np.dtype(prec.param_dtype)),
+                          str(np.dtype(prec.compute_dtype)),
+                          float(prec.bytes_per_param)],
+            "pipeline": [self._pipeline_stages, self._microbatches,
+                         self._pipeline_schedule, self._pipeline_virtual],
+            "seq": [self._seq_parallel, self._seq_impl],
+        }
+        return export_cache_mod.executable_key(
+            "train_step",
+            tune_cache.params_signature(abstract.params),
+            tune_cache.topology_fingerprint(topo),
+            program, tags=self._export_tags)
+
+    def _maybe_export_step(self, abstract, shardings,
+                           sample_batch) -> dict | None:
+        """Cache-first step compilation when the export cache is enabled
+        (constructor spec or ``TADNN_EXPORT_CACHE``); a silent no-op
+        otherwise — the lazy-jit path is unchanged by default."""
+        from .export import cache as export_cache_mod
+
+        cache = export_cache_mod.resolve(self._export_cache_spec)
+        if cache is None:
+            return None
+        return self._export_attach(cache, abstract, shardings, sample_batch)
+
+    def _export_attach(self, cache, abstract, shardings,
+                       sample_batch) -> dict:
+        """Load-or-compile the step executable and install the dispatch
+        shim.  On a hit the batch signature is pre-seeded into the
+        recompile accounting, so a warm start's first ``step()`` emits
+        NO compile event — the testable zero-compile contract.  On a
+        miss the AOT compile (which replaces the lazy first-dispatch
+        compile, not adds to it) is journaled as the standard
+        ``compile`` event so goodput accounting stays truthful."""
+        from .export import aot as aot_mod
+
+        def sds(a, s):
+            return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s)
+
+        state_abs = jax.tree.map(sds, abstract, shardings)
+        batch_abs = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
+            sample_batch,
+        )
+        key = self._export_key(abstract, sample_batch)
+        res = aot_mod.cached_compile(
+            self._step_fn, (state_abs, batch_abs),
+            cache=cache, kind="train_step", key=key)
+        if res is None:  # AOT compile failed — keep the lazy jit path
+            self._export_info = {"key": key, "kind": "train_step",
+                                 "source": "error"}
+            return self._export_info
+        sig = _abstract_signature(sample_batch)
+        self._fn_sigs.setdefault("train_step", set()).add(sig)
+        if res.source == "compile":
+            rec = {"event": "compile", "fn": "train_step",
+                   "dur_s": res.compile_s, "signature": _signature_str(sig)}
+            self.compile_events.append(rec)
+            obs_journal.event("compile", fn="train_step",
+                              dur_s=res.compile_s,
+                              signature=rec["signature"])
+        self._step_fn = _ExportedStep(res.compiled, self._step_fn, sig)
+        self._export_info = {"kind": "train_step", **res.to_json()}
+        return self._export_info
+
+    def export_step(self, rng: jax.Array, sample_batch: Any, *,
+                    cache: Any = None) -> dict:
+        """AOT-compile the train step and serialize it into the
+        executable cache (a warm key just validates + deserializes).
+
+        The ``tadnn export`` / launcher-prewarm entry point: run this in
+        any process that can see the target topology, and every later
+        ``init()`` with the same config on the same fingerprint starts
+        with zero XLA step compiles.  Returns the export info dict
+        (key, source, compile/deserialize wall, payload bytes).
+        """
+        from .export import cache as export_cache_mod
+
+        spec = cache if cache is not None else self._export_cache_spec
+        resolved = export_cache_mod.resolve(True if spec is None else spec)
+        if resolved is None:
+            raise ValueError(
+                "export cache disabled (export_cache=False) — pass a "
+                "cache path or set TADNN_EXPORT_CACHE")
+        if self.plan is None:
+            self.build_plan(rng, sample_batch)
+        self._check_batch(sample_batch)
+        abstract = jax.eval_shape(self._make_state_fn(sample_batch), rng)
+        shardings = self.state_shardings(abstract)
+        if self._step_fn is None:
+            self._compile_step(abstract, shardings)
+        return self._export_attach(resolved, abstract, shardings,
+                                   sample_batch)
 
     def compiled_step_text(self, rng: jax.Array,
                            sample_batch: Any) -> str | None:

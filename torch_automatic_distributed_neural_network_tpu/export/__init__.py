@@ -1,0 +1,42 @@
+"""AOT executable export: compile once, restart in seconds.
+
+Production elasticity dies on compile time — every launch re-plan,
+chaos-recovery restart, and new serve replica pays minutes of XLA
+compile before the first step or token.  This package extends the AOT
+hooks in ``core`` (``_abstract_step_args``, ``compiled_step_text``)
+into a content-addressed on-disk cache of SERIALIZED COMPILED
+EXECUTABLES (``jax.experimental.serialize_executable``), keyed by
+(params signature x topology fingerprint x plan/program blob) through
+the same machinery the tuning cache uses — tuner decisions and
+executables share one fingerprint.
+
+A restart with a warm cache deserializes instead of recompiling:
+``Trainer`` startup (via ``AutoDistribute(export_cache=...)``),
+``ServeEngine`` construction, and the launcher's elastic re-plan
+(background prewarm of likely shrink worlds) all go cache-first.
+Entries whose jax/XLA version or device fingerprint no longer match
+are skipped loudly (``export.stale`` journal event) and recompiled —
+never loaded blind.  CLI: ``tadnn export``.
+"""
+
+from .aot import ExportResult, ExportedCallable, cached_compile
+from .cache import (
+    ExecutableCache,
+    cache_dir,
+    env_fingerprint,
+    executable_key,
+    plan_blob,
+    resolve,
+)
+
+__all__ = [
+    "ExecutableCache",
+    "ExportResult",
+    "ExportedCallable",
+    "cache_dir",
+    "cached_compile",
+    "env_fingerprint",
+    "executable_key",
+    "plan_blob",
+    "resolve",
+]

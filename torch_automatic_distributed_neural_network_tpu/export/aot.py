@@ -1,0 +1,139 @@
+"""Cache-first AOT compilation: hit -> deserialize, miss -> compile,
+serialize, store.
+
+``cached_compile`` is the one entry point every wired-in site uses
+(``AutoDistribute.init``, ``ServeEngine.__init__``, the launcher
+prewarm, ``tadnn export``).  The journal tells the whole story per
+call:
+
+- ``export.hit``    deserialized in ``deserialize_s`` (the cold-start
+  win — orders of magnitude under the compile wall on real programs);
+- ``export.miss``   key not present, paying the compile;
+- ``export.stale``  key present but jax/XLA/device fingerprint moved
+  on, or the payload is torn — skipped LOUDLY and recompiled;
+- ``export.store``  fresh executable serialized (``compile_s``,
+  ``payload_bytes``);
+- ``export.error``  the AOT compile itself failed — the caller keeps
+  its lazy-jit path, nothing is cached;
+- ``export.fallback`` a deserialized executable rejected its runtime
+  arguments — dispatch fell back to the jit fn permanently.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Sequence
+
+from ..obs import journal as obs_journal
+from . import cache as cache_mod
+
+
+@dataclasses.dataclass
+class ExportResult:
+    """Outcome of one cache-first compile."""
+
+    key: str
+    kind: str
+    source: str  # "hit" (deserialized) | "compile" (fresh AOT)
+    compiled: Any
+    compile_s: float | None = None
+    deserialize_s: float | None = None
+    payload_bytes: int | None = None
+    stale_reason: str | None = None
+
+    def to_json(self) -> dict:
+        # no dataclasses.asdict: it deep-copies, and executables don't
+        out = {f.name: getattr(self, f.name)
+               for f in dataclasses.fields(self) if f.name != "compiled"}
+        return {k: v for k, v in out.items() if v is not None}
+
+
+def cached_compile(fn: Any, abstract_args: Sequence[Any], *,
+                   cache: "cache_mod.ExecutableCache | None",
+                   kind: str, key: str) -> ExportResult | None:
+    """Load-or-compile one executable.
+
+    ``fn`` is a jitted callable; ``abstract_args`` the sharding-annotated
+    ShapeDtypeStructs to lower with (nothing is materialized).  Returns
+    None when the AOT compile fails — callers keep their lazy jit path
+    and nothing lands in the cache.
+    """
+    stale_reason = None
+    if cache is not None:
+        rec = cache.lookup(key)
+        if rec is None:
+            obs_journal.event("export.miss", kind=kind, key=key)
+        else:
+            reason = cache.check_live(rec)
+            if reason is None:
+                t0 = time.perf_counter()
+                try:
+                    compiled = cache.load(key, rec)
+                except Exception as e:
+                    reason = f"deserialize failed: {type(e).__name__}: {e}"
+                else:
+                    dt = time.perf_counter() - t0
+                    obs_journal.event(
+                        "export.hit", kind=kind, key=key, deserialize_s=dt,
+                        payload_bytes=rec.get("payload_bytes"))
+                    return ExportResult(
+                        key, kind, "hit", compiled, deserialize_s=dt,
+                        payload_bytes=rec.get("payload_bytes"))
+            stale_reason = reason
+            obs_journal.event("export.stale", kind=kind, key=key,
+                              reason=reason)
+    t0 = time.perf_counter()
+    try:
+        compiled = fn.lower(*abstract_args).compile()
+    except Exception as e:
+        obs_journal.event("export.error", kind=kind, key=key,
+                          error=f"{type(e).__name__}: {e}")
+        return None
+    compile_s = time.perf_counter() - t0
+    res = ExportResult(key, kind, "compile", compiled,
+                       compile_s=compile_s, stale_reason=stale_reason)
+    if cache is not None:
+        try:
+            rec = cache.store(key, compiled, kind=kind,
+                              meta={"compile_s": compile_s})
+        except Exception as e:
+            # a read-only cache dir or an unserializable backend must
+            # not take down the run — the compile already succeeded
+            obs_journal.event("export.error", kind=kind, key=key,
+                              error=f"store failed: "
+                                    f"{type(e).__name__}: {e}")
+        else:
+            res.payload_bytes = rec.get("payload_bytes")
+            obs_journal.event(
+                "export.store", kind=kind, key=key, compile_s=compile_s,
+                payload_bytes=rec.get("payload_bytes"),
+                file=rec.get("file"))
+    return res
+
+
+class ExportedCallable:
+    """Dispatch shim over a fixed-shape call site (the serve traces):
+    run the AOT executable; if it ever rejects its arguments, journal
+    ``export.fallback`` once and dispatch through the original jit fn
+    from then on.  ``lower`` delegates to the jit fn so HLO inspection
+    keeps working."""
+
+    def __init__(self, compiled: Any, fallback: Any, name: str):
+        self._compiled = compiled
+        self._fallback = fallback
+        self._name = name
+
+    def __call__(self, *args):
+        if self._compiled is not None:
+            try:
+                return self._compiled(*args)
+            except Exception as e:  # argument-check time: nothing donated
+                obs_journal.event(
+                    "export.fallback", fn=self._name,
+                    error=f"{type(e).__name__}: {e}")
+                self._compiled = None
+        return self._fallback(*args)
+
+    def lower(self, *args, **kwargs):
+        return self._fallback.lower(*args, **kwargs)

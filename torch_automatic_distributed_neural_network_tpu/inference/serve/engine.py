@@ -343,7 +343,9 @@ class ServeEngine:
                  mesh=None,
                  disaggregate: bool = False,
                  rng: jax.Array | None = None,
-                 journal: Any = None):
+                 journal: Any = None,
+                 export_cache: Any = None,
+                 export_tags: Any = None):
         if attention_impl not in ("paged", "dense"):
             raise ValueError(
                 f"unknown attention_impl {attention_impl!r} "
@@ -427,6 +429,21 @@ class ServeEngine:
             self._prefill_lora_fn = jax.jit(
                 partial(_prefill_chunk_lora_step, cfg=self.cfg,
                         moe_decode=moe_decode, lora_spec=lora_spec))
+        # AOT executable cache (export/): replica spin-up goes
+        # cache-first on the two fixed-shape serve traces, so a warm
+        # replica deserializes the decode step and the prefill chunk
+        # instead of paying their XLA compiles before the first token.
+        self.export_info: list[dict] = []
+        from ...export import cache as _export_cache_mod
+
+        _cache = _export_cache_mod.resolve(export_cache)
+        if _cache is not None:
+            self._export_compiled(
+                _cache, dict(export_tags or {}),
+                num_blocks=num_blocks, block_size=block_size,
+                quant_kv=bool(quant_kv), cache_dtype=cache_dtype,
+                n_adapters=n_adapters,
+                quant_adapters=bool(quant_adapters))
         if self.journal is not None:
             from ...ops.paged_attention import tensor_degree
 
@@ -441,6 +458,74 @@ class ServeEngine:
                 speculative=self.speculative,
                 disaggregate=self.disaggregate,
                 tp=tensor_degree(mesh))
+
+    def _export_compiled(self, cache, tags: dict, *, num_blocks: int,
+                         block_size: int, quant_kv: bool, cache_dtype,
+                         n_adapters: int, quant_adapters: bool) -> None:
+        """Cache-first AOT for the two fixed-shape serve traces (decode
+        step and base prefill chunk).  Abstract args come from
+        ``jax.eval_shape`` over the exact runtime operands — nothing is
+        materialized, and the traces match dispatch bit-for-bit.  The
+        per-prompt-length LoRA prefill stays lazy (one trace per tenant
+        factor tree isn't worth pinning)."""
+        from ...export import aot as aot_mod
+        from ...export import cache as export_cache_mod
+        from ...topology import detect
+        from ...tune import cache as tune_cache
+
+        S, MB, T = self.n_slots, self.max_blocks, 1 + self.speculative
+        devices = (list(self.mesh.devices.flat)
+                   if self.mesh is not None else None)
+        topo_fp = tune_cache.topology_fingerprint(detect(devices))
+        sig = tune_cache.params_signature(self.params)
+        # everything the serve traces close over: two engines that
+        # differ in any of these must compile separately
+        program = {
+            "n_slots": S, "max_len": self.max_len,
+            "block_size": block_size, "num_blocks": num_blocks,
+            "attention_impl": self.attention_impl,
+            "speculative": self.speculative,
+            "moe_decode": self.moe_decode,
+            "quant_kv": quant_kv,
+            "cache_dtype": str(np.dtype(cache_dtype)),
+            "sample": dataclasses.asdict(self.sample),
+            "prefill_chunk": self.prefill_chunk,
+            "lora": ([self.lora_spec.rank, self.lora_spec.scaling,
+                      n_adapters, quant_adapters]
+                     if self.lora_spec is not None else None),
+        }
+        factors = (self.adapter_pool.factors
+                   if self.adapter_pool is not None else {})
+        decode_abs = jax.eval_shape(lambda: (
+            self.params, self.pool.kv,
+            jnp.zeros((S, MB), jnp.int32), jnp.zeros((S,), jnp.int32),
+            jnp.zeros((S, T), jnp.int32), jnp.zeros((S,), jnp.bool_),
+            factors, jnp.zeros((S,), jnp.int32),
+            jax.random.fold_in(self._rng, 2**20)))
+        res = aot_mod.cached_compile(
+            self._step_fn, decode_abs, cache=cache, kind="serve_decode",
+            key=export_cache_mod.executable_key(
+                "serve_decode", sig, topo_fp, program, tags))
+        if res is not None:
+            self._step_fn = aot_mod.ExportedCallable(
+                res.compiled, self._step_fn, "serve_decode")
+            self.export_info.append(res.to_json())
+        if self.prefill_chunk:
+            C = self.prefill_chunk
+            prefill_abs = jax.eval_shape(lambda: (
+                self.params, jnp.zeros((1, C), jnp.int32),
+                KVCache.init(self.cfg, 1, self.max_len,
+                             dtype=jnp.bfloat16),
+                np.int32(0)))
+            res = aot_mod.cached_compile(
+                self._prefill_fn, prefill_abs, cache=cache,
+                kind="serve_prefill",
+                key=export_cache_mod.executable_key(
+                    "serve_prefill", sig, topo_fp, program, tags))
+            if res is not None:
+                self._prefill_fn = aot_mod.ExportedCallable(
+                    res.compiled, self._prefill_fn, "serve_prefill")
+                self.export_info.append(res.to_json())
 
     # -- request intake ------------------------------------------------------
 
@@ -590,12 +675,15 @@ class ServeEngine:
         n_real = len(chunk)
         tokens = jnp.asarray(chunk + [0] * (C - n_real), jnp.int32)[None]
         t0 = time.monotonic()
+        # np.int32, not a weak-typed python int: the AOT-exported trace
+        # pins the cursor's dtype, and jit would silently retrace
+        last_idx = np.int32(n_real - 1)
         if st.lora is None:
             logits, st.cache = self._prefill_fn(
-                self.params, tokens, st.cache, n_real - 1)
+                self.params, tokens, st.cache, last_idx)
         else:
             logits, st.cache = self._prefill_lora_fn(
-                self.params, st.lora, tokens, st.cache, n_real - 1)
+                self.params, st.lora, tokens, st.cache, last_idx)
         st.pos += n_real
         done = st.pos >= req.n_prompt
         bounced = done and not self._bind_adapter(slot, req)

@@ -143,6 +143,43 @@ def generate(target: str, metrics_path: str | None = None) -> dict:
             for e in recompiles
         ],
     }
+    exports = [e for e in events
+               if (e.get("name") or "").startswith("export.")]
+    if exports:
+        hits = [e for e in exports if e["name"] == "export.hit"]
+        stores = [e for e in exports if e["name"] == "export.store"]
+        stales = [e for e in exports if e["name"] == "export.stale"]
+        deser = sum(_finite(e.get("deserialize_s") for e in hits))
+        compw = sum(_finite(e.get("compile_s") for e in stores))
+        exp: dict[str, Any] = {
+            "hits": len(hits),
+            "misses": len([e for e in exports
+                           if e["name"] == "export.miss"]),
+            "stores": len(stores),
+            "stale": len(stales),
+            "fallbacks": len([e for e in exports
+                              if e["name"] == "export.fallback"]),
+            "errors": len([e for e in exports
+                           if e["name"] == "export.error"]),
+            "prewarms": len([e for e in exports
+                             if e["name"] == "export.prewarm"]),
+            "deserialize_total_s": deser or None,
+            "mean_deserialize_s": _mean(e.get("deserialize_s")
+                                        for e in hits),
+            "compile_total_s": compw or None,
+            "mean_compile_s": _mean(e.get("compile_s") for e in stores),
+            # the cold-start win this run actually realized: compile
+            # wall of the entries it wrote vs deserialize wall of the
+            # entries it read (same-config runs make this the speedup)
+            "compile_over_deserialize": (
+                round(compw / deser, 1) if compw and deser else None),
+            "stale_reasons": [
+                {k: e.get(k) for k in ("kind", "reason")}
+                for e in stales
+            ] or None,
+        }
+        report["export"] = {k: v for k, v in exp.items()
+                            if v is not None}
     good = last("goodput")
     if good:
         report["goodput"] = {k: good.get(k)
@@ -525,6 +562,35 @@ def format_report(report: dict) -> str:
         + ("  <- shape churn, check input pipeline"
            if c["recompile_count"] else "")
     )
+    ex = report.get("export")
+    if ex:
+        parts = [f"export cache: {ex.get('hits', 0)} hit(s)"]
+        if ex.get("mean_deserialize_s") is not None:
+            parts.append(
+                f"deserialize {ex['mean_deserialize_s'] * 1e3:.1f}ms mean")
+        if ex.get("stores"):
+            parts.append(f"{ex['stores']} store(s)")
+        if ex.get("mean_compile_s") is not None:
+            parts.append(f"compile {ex['mean_compile_s']:.2f}s mean")
+        if ex.get("compile_over_deserialize"):
+            parts.append(
+                f"{ex['compile_over_deserialize']}x compile/deserialize")
+        if ex.get("prewarms"):
+            parts.append(f"{ex['prewarms']} prewarm(s)")
+        lines.append("  ".join(parts))
+        if ex.get("stale"):
+            reasons = ex.get("stale_reasons") or []
+            first = reasons[0].get("reason") if reasons else None
+            lines.append(
+                f"  STALE entries skipped: {ex['stale']} (recompiled)"
+                + (f" — {first}" if first else ""))
+        if ex.get("fallbacks"):
+            lines.append(
+                f"  !! {ex['fallbacks']} exported executable(s) "
+                f"rejected runtime args — fell back to jit")
+        if ex.get("errors"):
+            lines.append(f"  !! {ex['errors']} export error(s) "
+                         f"(see export.error events)")
     tr = report.get("training")
     if tr:
         parts = [f"steps logged: {tr['n_step_records']}"]

@@ -88,6 +88,12 @@ class LaunchConfig:
     worker_restarts: int = 0  # in-process run_with_recovery budget
     chaos: ChaosPlan | None = None
     simulate: bool = True  # cpu_sim_env for workers (real backend: False)
+    # AOT executable cache dir shared by the cohort: workers go
+    # cache-first on the step compile (export/), restarted cohorts hit
+    # instead of recompiling, and with elastic=True the launcher
+    # prewarms the likely shrink world sizes in the background so a
+    # scale-down restart finds its executable already serialized
+    export_cache: str | None = None
     # worker model/data (the multihost smoke workload; small on purpose)
     vocab_size: int = 512
     seq_len: int = 33
@@ -166,6 +172,8 @@ class Launcher:
             os.path.join(self.launch_dir, "journal_launcher.jsonl"),
             host0_only=False, meta={"role": "launcher"})
         self._chaos_fired: set[tuple[str, int]] = set()
+        self._prewarm_procs: list[subprocess.Popen] = []
+        self._prewarmed: set[int] = set()
         self._state: dict = {
             "max_restarts": cfg.max_restarts,
             "restarts_used": 0,
@@ -274,6 +282,8 @@ class Launcher:
         coord = (f"127.0.0.1:{_free_port()}"
                  if world > 1 and not logical else "")
         env = _sim_env(cfg.local_devices) if cfg.simulate else dict(os.environ)
+        if cfg.export_cache:
+            env["TADNN_EXPORT_CACHE"] = os.path.expanduser(cfg.export_cache)
         procs = []
         for i in range(world):
             cmd = [
@@ -294,6 +304,9 @@ class Launcher:
             ]
             if cfg.zero1:
                 cmd.append("--zero1")
+            if cfg.export_cache:
+                cmd += ["--export-cache",
+                        os.path.expanduser(cfg.export_cache)]
             if logical:
                 cmd.append("--logical-hosts")
             if (cfg.chaos is not None
@@ -310,6 +323,60 @@ class Launcher:
                            coordinator=coord or None, logical=logical,
                            pids=[p.pid for p in procs])
         return procs
+
+    def _prewarm(self, world: int) -> None:
+        """Background cache-fill for a world size the elastic policy
+        may shrink to: a detached ``--prewarm`` process builds the
+        exact worker plan at that world and runs the cache-first AOT
+        export, so a scale-down restart opens on ``export.hit``
+        instead of a fresh XLA compile.  Fire-and-forget — a prewarm
+        failure costs nothing but the warm start."""
+        cfg = self.cfg
+        if not cfg.export_cache or world < 1 or world in self._prewarmed:
+            return
+        self._prewarmed.add(world)
+        env = (_sim_env(cfg.local_devices) if cfg.simulate
+               else dict(os.environ))
+        env["TADNN_EXPORT_CACHE"] = os.path.expanduser(cfg.export_cache)
+        cmd = [
+            sys.executable, "-m", f"{_PKG}.training.launch", "--worker",
+            "--prewarm",
+            "--launch-dir", self.launch_dir,
+            "--process-id", "0", "--num-processes", str(world),
+            "--strategy", cfg.strategy,
+            "--seed", str(cfg.seed),
+            "--vocab-size", str(cfg.vocab_size),
+            "--seq-len", str(cfg.seq_len),
+            "--batch-size", str(cfg.batch_size),
+            "--lr", str(cfg.lr),
+            "--export-cache", os.path.expanduser(cfg.export_cache),
+        ]
+        if cfg.zero1:
+            cmd.append("--zero1")
+        if cfg.simulate and world > 1:
+            cmd.append("--logical-hosts")
+        log = open(os.path.join(self.launch_dir,
+                                f"prewarm_w{world}.log"), "ab")
+        proc = subprocess.Popen(cmd, env=env, stdout=log,
+                                stderr=subprocess.STDOUT,
+                                cwd=self.launch_dir)
+        log.close()
+        self._prewarm_procs.append(proc)
+        self.journal.event("export.prewarm", world=world, pid=proc.pid)
+
+    def _reap_prewarms(self) -> None:
+        """Wait briefly for in-flight prewarms (so no zombies outlive
+        the launcher), then force-kill stragglers."""
+        for p in self._prewarm_procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                try:
+                    p.kill()
+                    p.wait(timeout=5)
+                except (OSError, subprocess.TimeoutExpired):
+                    pass
+        self._prewarm_procs = []
 
     def _kill_cohort(self, procs: list[subprocess.Popen]) -> None:
         for p in procs:
@@ -383,6 +450,13 @@ class Launcher:
         round_idx = 0
         restarts = 0
         with obs_journal.as_default(self.journal):
+            if cfg.elastic and cfg.export_cache:
+                # prewarm the nearest shrink worlds while round 0 runs;
+                # on the simulated mesh all logical worlds share one
+                # topology fingerprint so the first prewarm covers all,
+                # but real backends get one key (and one payload) each
+                for w in list(range(world - 1, cfg.min_hosts - 1, -1))[:2]:
+                    self._prewarm(w)
             while True:
                 self._state["world_history"].append(world)
                 for i in range(world):  # stale results must not satisfy
@@ -401,6 +475,7 @@ class Launcher:
                     "failed_step": verdict["step"],
                 })
                 if verdict["ok"]:
+                    self._reap_prewarms()
                     results = self._collect(world)
                     self._state.update(done=True, ok=True)
                     self._save_state()
@@ -441,6 +516,7 @@ class Launcher:
                     reason=verdict["reason"], restarts=restarts,
                     max_restarts=cfg.max_restarts, gave_up=gave_up)
                 if gave_up:
+                    self._reap_prewarms()
                     self._state.update(done=True, ok=False)
                     self._save_state()
                     self._merge_journals()
@@ -463,6 +539,9 @@ class Launcher:
                         world_to=new_world, strategy=cfg.strategy,
                         reason=verdict["reason"])
                     world = new_world
+                    # keep one prewarm ahead of the shrink frontier
+                    if new_world - 1 >= cfg.min_hosts:
+                        self._prewarm(new_world - 1)
                 self._save_state()
                 self.policy.sleep(self.policy.delay_s(restarts))
                 round_idx += 1
@@ -551,6 +630,7 @@ def _worker_main(args) -> int:
         loss_fn=next_token_loss,
         strategy=args.strategy,
         zero1=args.zero1,
+        export_cache=(args.export_cache or None),
     )
     ckpt = ShardedCheckpoint(
         os.path.join(args.launch_dir, CKPT_DIRNAME),
@@ -606,6 +686,48 @@ def _worker_main(args) -> int:
     with open(tmp, "w") as f:
         json.dump(result, f)
     os.replace(tmp, path)
+    journal.close()
+    return 0
+
+
+def _prewarm_main(args) -> int:
+    """``--prewarm`` entry: build the exact worker model and plan for
+    the target world size and run the cache-first AOT export
+    (:meth:`AutoDistribute.export_step`), then exit.  Spawned in the
+    background by an elastic launcher so the shrink cohort's step
+    executable is already serialized when a host dies."""
+    import jax
+    import optax
+
+    import torch_automatic_distributed_neural_network_tpu as tad
+    from ..data.synthetic import SyntheticLM
+    from ..models import GPT2
+    from .losses import next_token_loss
+
+    journal = obs_journal.Journal(
+        os.path.join(args.launch_dir,
+                     f"journal_prewarm_w{args.num_processes}.jsonl"),
+        host0_only=False,
+        meta={"role": "prewarm", "world": args.num_processes,
+              "pid": os.getpid()})
+    data = _HostSliced(SyntheticLM(
+        vocab_size=args.vocab_size, seq_len=args.seq_len,
+        batch_size=args.batch_size))
+    ad = tad.AutoDistribute(
+        GPT2("test", vocab_size=args.vocab_size,
+             max_seq_len=args.seq_len - 1),
+        optimizer=optax.sgd(args.lr),
+        loss_fn=next_token_loss,
+        strategy=args.strategy,
+        zero1=args.zero1,
+    )
+    with obs_journal.as_default(journal):
+        # same rng default as Trainer._fit, so the abstract state (and
+        # therefore the cache key) matches the cohort's exactly
+        info = ad.export_step(jax.random.key(0), data.batch(0),
+                              cache=args.export_cache or True)
+        journal.event("export.prewarm_done", world=args.num_processes,
+                      key=info.get("key"), source=info.get("source"))
     journal.close()
     return 0
 
@@ -718,6 +840,13 @@ def _worker_argparser():
     p.add_argument("--seq-len", type=int, default=33)
     p.add_argument("--batch-size", type=int, default=16)
     p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--export-cache", default="",
+                   help="AOT executable cache dir (export/): cache-first "
+                        "step compilation, shared across cohorts")
+    p.add_argument("--prewarm", action="store_true",
+                   help="build the plan for --num-processes, export the "
+                        "step executable into --export-cache, and exit "
+                        "(no training)")
     p.add_argument("--sigkill-at", type=int, action="append",
                    help="chaos: SIGKILL self right after this step "
                         "(once per launch, latched in the launch dir)")
@@ -735,6 +864,8 @@ def main(argv: list[str] | None = None) -> int:
         print("this entry point is worker-only; use `tadnn launch`",
               file=sys.stderr)
         return 2
+    if args.prewarm:
+        return _prewarm_main(args)
     return _worker_main(args)
 
 

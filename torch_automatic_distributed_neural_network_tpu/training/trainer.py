@@ -508,6 +508,7 @@ class Trainer:
                 "run_end", stop_step=last_done,
                 n_compiles=self.ad.n_compiles,
                 recompiles=self.ad.recompile_count,
+                export=getattr(self.ad, "_export_info", None),
             )
         return state
 

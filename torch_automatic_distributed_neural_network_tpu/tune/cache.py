@@ -10,6 +10,14 @@ line, they never corrupt a decision.
 The key hashes everything a decision depends on, so any change —
 different model shapes, different device count/kind/slicing, different
 search policy — misses cleanly instead of replaying a stale plan.
+
+Size cap: append-only means unbounded growth on long-lived machines.
+``TADNN_TUNE_CACHE_MAX_BYTES`` (same contract as the journal's
+``TADNN_JOURNAL_MAX_BYTES``, default off) caps the file: when an
+append crosses the cap, :func:`compact_jsonl` rewrites it keeping only
+the LAST record per key (the record ``lookup`` would return anyway),
+then sheds oldest-first if still over.  The export subsystem's
+executable index (``export/cache.py``) shares this exact compaction.
 """
 
 from __future__ import annotations
@@ -26,11 +34,19 @@ from .. import planner
 from .. import topology as topo_mod
 
 _ENV = "TADNN_TUNE_CACHE"
+_ENV_MAX = "TADNN_TUNE_CACHE_MAX_BYTES"
 _DEFAULT = "~/.cache/tadnn/tune_cache.jsonl"
 
 
 def cache_path(path: str | None = None) -> str:
     return os.path.expanduser(path or os.environ.get(_ENV) or _DEFAULT)
+
+
+def _env_max_bytes() -> int:
+    try:
+        return int(os.environ.get(_ENV_MAX, "0"))
+    except ValueError:
+        return 0
 
 
 def params_signature(abstract_params: Any) -> str:
@@ -100,10 +116,72 @@ def lookup(key: str, path: str | None = None) -> dict | None:
     return hit
 
 
-def store(key: str, record: Mapping, path: str | None = None) -> str:
-    """Append a decision; returns the file written."""
+def store(key: str, record: Mapping, path: str | None = None,
+          max_bytes: int | None = None) -> str:
+    """Append a decision; returns the file written.
+
+    ``max_bytes`` caps the file via :func:`compact_jsonl` (None reads
+    ``TADNN_TUNE_CACHE_MAX_BYTES``; 0 disables — callers with their own
+    compaction schedule, like the export index, pass 0).
+    """
     p = cache_path(path)
     os.makedirs(os.path.dirname(p) or ".", exist_ok=True)
     with open(p, "a") as f:
         f.write(json.dumps({"key": key, "record": dict(record)}) + "\n")
+    cap = _env_max_bytes() if max_bytes is None else max_bytes
+    if cap:
+        try:
+            over = os.path.getsize(p) >= cap
+        except OSError:
+            over = False
+        if over:
+            compact_jsonl(p, max_bytes=cap)
     return p
+
+
+def compact_jsonl(path: str, max_bytes: int = 0) -> dict:
+    """Dedup-compact an append-only keyed JSONL file in place.
+
+    Keeps the LAST record per key (last-match-wins semantics preserved
+    bit-for-bit: every surviving key still resolves to the same record
+    ``lookup`` returned before), ordered by last occurrence; torn lines
+    are dropped.  If the result still exceeds ``max_bytes`` (when
+    nonzero), oldest entries are shed first.  Atomic (tmp +
+    ``os.replace``), so a concurrent reader sees either generation,
+    never a torn file.  Returns compaction stats.
+    """
+    if not os.path.isfile(path):
+        return {"before_bytes": 0, "after_bytes": 0, "kept": 0,
+                "dropped": 0}
+    before = os.path.getsize(path)
+    last: dict[str, str] = {}  # key -> raw line, in last-occurrence order
+    total = 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn write — compaction discards it
+            if not isinstance(rec, dict) or rec.get("key") is None:
+                continue
+            total += 1
+            last.pop(rec["key"], None)  # re-insert at the end
+            last[rec["key"]] = line
+    lines = list(last.values())
+    dropped = total - len(lines)
+    if max_bytes:
+        size = sum(len(ln) + 1 for ln in lines)
+        while lines and size > max_bytes:
+            size -= len(lines[0]) + 1
+            lines.pop(0)
+            dropped += 1
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        for ln in lines:
+            f.write(ln + "\n")
+    os.replace(tmp, path)
+    return {"before_bytes": before, "after_bytes": os.path.getsize(path),
+            "kept": len(lines), "dropped": dropped}

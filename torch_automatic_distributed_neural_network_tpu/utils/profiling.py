@@ -73,6 +73,25 @@ def _bytes_accessed_of(compiled) -> float | None:
         return None
 
 
+# content-addressed memo for cost analyses: the analysis of an HLO
+# module is a pure function of its text, so the digest of the lowered
+# program is the whole key.  In-process hits skip the XLA compile;
+# cross-process hits ride in the export cache's index as JSON-only
+# records (no payload file), validated against the same env
+# fingerprint as executables.
+_cost_memo: dict[str, dict] = {}
+
+
+def _cost_cache_key(lowered) -> str | None:
+    import hashlib
+
+    try:
+        text = lowered.as_text()
+    except Exception:
+        return None  # backend can't render — compile uncached
+    return "cost-" + hashlib.sha256(text.encode()).hexdigest()[:32]
+
+
 def compiled_cost(fn, *args, **kwargs) -> dict | None:
     """ONE AOT compile, all analyses: ``{'flops': ..., 'memory': ...,
     'bytes_accessed': ...}``.
@@ -80,19 +99,46 @@ def compiled_cost(fn, *args, **kwargs) -> dict | None:
     Prefer this over calling :func:`compiled_flops` and
     :func:`compiled_memory` separately — each does its own
     lower().compile(), minutes of redundant XLA work on big sharded
-    steps.
+    steps.  Results are memoized on the digest of the lowered HLO (and,
+    when the export cache is enabled via ``TADNN_EXPORT_CACHE``,
+    persisted in its index), so repeated what-if sweeps over the same
+    program skip the compile entirely — a ``cost_analysis.cached``
+    event marks each skip.
 
     Lower/compile failures return ``{'flops': None, 'memory': None,
     'error': '<reason>'}`` (and emit a ``cost_analysis.error`` journal
     event), so "compile failed: <why>" is distinguishable from "compiled
     fine but the backend exposes no analysis" (which returns analysis
-    fields of None with NO 'error' key).
+    fields of None with NO 'error' key).  Failures are never cached.
     """
     from ..obs import journal as _journal
 
     try:
+        lowered = fn.lower(*args, **kwargs)
+    except Exception as e:
+        reason = f"{type(e).__name__}: {e}"
+        _journal.event("cost_analysis.error", error=reason)
+        return {"flops": None, "memory": None, "error": reason}
+    key = _cost_cache_key(lowered)
+    if key is not None and key in _cost_memo:
+        _journal.event("cost_analysis.cached", key=key, tier="memory")
+        return dict(_cost_memo[key])
+    cache = None
+    if key is not None:
+        from ..export import cache as _export_cache
+
+        cache = _export_cache.resolve(None)  # env-gated, off by default
+        if cache is not None:
+            rec = cache.lookup(key)
+            if rec is not None and cache.check_live(rec) is None:
+                analysis = rec.get("analysis") or {}
+                _cost_memo[key] = dict(analysis)
+                _journal.event("cost_analysis.cached", key=key,
+                               tier="disk")
+                return dict(analysis)
+    try:
         with _journal.span("compile", fn="aot_cost_analysis"):
-            compiled = fn.lower(*args, **kwargs).compile()
+            compiled = lowered.compile()
     except Exception as e:
         reason = f"{type(e).__name__}: {e}"
         _journal.event("cost_analysis.error", error=reason)
@@ -101,6 +147,17 @@ def compiled_cost(fn, *args, **kwargs) -> dict | None:
     ba = _bytes_accessed_of(compiled)
     if ba is not None:
         out["bytes_accessed"] = ba
+    if key is not None:
+        _cost_memo[key] = dict(out)
+        if cache is not None:
+            try:
+                cache.put_record(key, {
+                    "kind": "cost_analysis",
+                    "env": _export_cache.env_fingerprint(),
+                    "analysis": dict(out),
+                })
+            except OSError:
+                pass  # read-only cache dir — the analysis still returns
     return out
 
 
