@@ -438,3 +438,81 @@ def test_compiled_cost_failure_not_cached(tmp_path, monkeypatch):
     assert not profiling._cost_memo
     assert not [r for r in j.records
                 if r["name"] == "cost_analysis.cached"]
+
+
+# -- GC by last-hit age (tadnn export --gc) -----------------------------------
+
+
+def _entry(cache_dir):
+    c = ExecutableCache(cache_dir)
+    (key, rec), = c.entries().items()
+    return c, key, rec
+
+
+def test_gc_drops_cold_entries_and_keeps_fresh(tmp_path):
+    cache = str(tmp_path / "exe")
+    j = obs_journal.Journal(path=None)
+    with obs_journal.as_default(j):
+        make_ad().export_step(jax.random.key(0), toy_batch(), cache=cache)
+        c, key, rec = _entry(cache)
+        payload = c.payload_path(key)
+        assert os.path.isfile(payload)
+        # fresh entry survives any sane window ...
+        assert c.gc(max_age_s=3600.0)["dropped"] == 0
+        # ... and a zero window reaps it: payload gone, index rewritten
+        stats = c.gc(max_age_s=0.0)
+    assert stats["dropped"] == 1 and stats["kept"] == 0
+    assert stats["payload_bytes_freed"] > 0
+    assert not os.path.isfile(payload)
+    assert c.entries() == {}
+    gcs = [r for r in j.records if r["name"] == "export.gc"]
+    assert len(gcs) == 2 and gcs[-1]["dropped"] == 1
+
+
+def test_hit_refreshes_last_hit_so_hot_entries_survive_gc(tmp_path):
+    cache = str(tmp_path / "exe")
+    train_run(cache)  # cold: compile + store
+    c, key, rec = _entry(cache)
+    # backdate the store far past any retention window
+    rec = dict(rec)
+    rec["created"] = 1.0
+    rec.pop("last_hit", None)
+    c.put_record(key, rec)
+    # a warm run hits the entry, and the hit must refresh last_hit
+    _, _, warm_rec, _ = train_run(cache)
+    assert names(warm_rec) == ["export.hit"]
+    refreshed = c.entries()[key]
+    assert refreshed.get("last_hit", 0.0) > 1.0
+    j = obs_journal.Journal(path=None)
+    with obs_journal.as_default(j):
+        assert c.gc(max_age_s=3600.0)["dropped"] == 0  # hot: kept
+    assert os.path.isfile(c.payload_path(key))
+    # without the touch the same window would have reaped it
+    stale = dict(refreshed)
+    stale["created"] = 1.0
+    stale["last_hit"] = 1.0
+    c.put_record(key, stale)
+    with obs_journal.as_default(j):
+        assert c.gc(max_age_s=3600.0)["dropped"] == 1
+
+
+def test_cli_export_gc(tmp_path, capsys):
+    cache = str(tmp_path / "exe")
+    argv = ["export", "--family", "mlp", "--size", "32,16,10", "--seq", "4",
+            "--batch", "8", "--strategy", "dp", "--cache", cache, "--json"]
+    assert cli.main(argv) == 0
+    capsys.readouterr()
+    # retention window large: nothing dropped, entry still verifies live
+    assert cli.main(["export", "--gc", "--max-age-days", "30",
+                     "--cache", cache, "--json"]) == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["dropped"] == 0 and out["kept"] == 1
+    # zero-day retention: reaped via the CLI path
+    assert cli.main(["export", "--gc", "--max-age-days", "0",
+                     "--cache", cache, "--json"]) == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["dropped"] == 1 and out["payload_bytes_freed"] > 0
+    assert cli.main(["export", "--verify", "--cache", cache,
+                     "--json"]) == 0
+    ver = json.loads(capsys.readouterr().out.strip())
+    assert ver["entries"] == []

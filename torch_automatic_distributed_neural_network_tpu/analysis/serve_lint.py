@@ -25,6 +25,10 @@ from typing import Any, Mapping
 from . import ERROR, WARN, Finding
 from .mem_lint import DEFAULT_HEADROOM, _fmt_bytes, resolve_budget
 
+# host-side radix-index metadata per resident block: 24-hex key string,
+# parent/child dict entries, and a float timestamp (prefix_cache._Node)
+PREFIX_NODE_BYTES = 192
+
 
 def _pool_specs(cfg, degrees: Mapping[str, int], quantize: bool):
     """Abstract pool pytree + matching PartitionSpec tree for ONE block
@@ -69,6 +73,8 @@ def serve_estimate(cfg, *,
                    adapters: int | None = None,
                    adapter_rank: int = 8,
                    quant_adapters: bool = False,
+                   prefix_cache: bool = False,
+                   expected_hit_rate: float = 0.0,
                    degrees: Mapping[str, int] | None = None,
                    ) -> tuple[list[Finding], dict[str, Any]]:
     """(findings, estimate) for a serving deployment of ``cfg``.
@@ -89,6 +95,17 @@ def serve_estimate(cfg, *,
     term alone turns a >=1-stream deployment into a 0-stream one, the
     finding is ML006, not ML004 — the fix is a smaller/int8 adapter
     pool, not a smaller KV pool.
+
+    ``prefix_cache`` charges the radix index's host-side metadata (one
+    node per resident block — hash key, pointers, timestamp; see
+    ``PREFIX_NODE_BYTES``) against the pool budget, and
+    ``expected_hit_rate`` — the expected fraction of prompt tokens
+    served from cache on this deployment's traffic — reprices stream
+    capacity: the cached prefix is resident ONCE and shared, so each
+    concurrent stream uniquely owns only its uncached blocks
+    (``effective_max_streams``).  The same knob is what
+    ``tune/simulate.py`` prices per-request from its TrafficMix, so the
+    static and replayed numbers share a vocabulary.
 
     ``attention_impl`` matches the engine's knob: the ``"dense"`` decode
     path materializes one layer's gathered K and V views per step
@@ -126,6 +143,19 @@ def serve_estimate(cfg, *,
     usable = (int(budget_bytes * (1.0 - headroom)) - int(params_bytes)
               - adapter_pool_bytes)
     num_blocks = max(0, usable // max(1, block_bytes_dev))
+    prefix_index_bytes = 0
+    if prefix_cache:
+        if not 0.0 <= expected_hit_rate < 1.0:
+            raise ValueError(
+                f"expected_hit_rate={expected_hit_rate} must be in "
+                "[0, 1)")
+        # radix node per resident block (worst case: every block
+        # indexed) — hash key string, parent/children entries, float
+        # timestamp.  Host RAM in practice, charged here so the
+        # estimate is conservative and the knob is never free.
+        prefix_index_bytes = num_blocks * PREFIX_NODE_BYTES
+        usable -= prefix_index_bytes
+        num_blocks = max(0, usable // max(1, block_bytes_dev))
     blocks_per_stream = blocks_for_tokens(max_len, block_size)
     # one block is the reserved null block (kv_pool.NULL_BLOCK)
     max_streams = max(0, (num_blocks - 1) // blocks_per_stream)
@@ -151,6 +181,18 @@ def serve_estimate(cfg, *,
             0, (usable - decode_workspace_bytes) // max(1, block_bytes_dev))
         max_streams = max(0, (num_blocks - 1) // blocks_per_stream)
 
+    # expected-hit-rate repricing: the cached prefix (hit_rate of each
+    # prompt's blocks, to first order) is resident once and SHARED, so
+    # each concurrent stream uniquely consumes only its uncached
+    # blocks.  effective_max_streams is the shared-traffic capacity.
+    effective_max_streams = max_streams
+    if prefix_cache and expected_hit_rate > 0.0 and max_streams >= 1:
+        shared_blocks = int(round(blocks_per_stream * expected_hit_rate))
+        unique_blocks = max(1, blocks_per_stream - shared_blocks)
+        effective_max_streams = max(
+            max_streams,
+            (num_blocks - 1 - shared_blocks) // unique_blocks)
+
     est: dict[str, Any] = {
         "attention_impl": attention_impl,
         "decode_workspace_bytes": decode_workspace_bytes,
@@ -172,6 +214,11 @@ def serve_estimate(cfg, *,
         "n_adapters": int(adapters or 0),
         "adapter_rank": int(adapter_rank) if adapters else None,
         "quant_adapters": bool(quant_adapters and adapters),
+        "prefix_cache": bool(prefix_cache),
+        "prefix_index_bytes": int(prefix_index_bytes),
+        "expected_hit_rate": float(expected_hit_rate) if prefix_cache
+        else None,
+        "effective_max_streams": int(effective_max_streams),
     }
 
     findings: list[Finding] = []

@@ -400,6 +400,7 @@ def cmd_simulate(args: argparse.Namespace) -> int:
             prefill_chunk=(int(getattr(args, "prefill_chunk", None) or 32)
                            or None),
             disaggregate=bool(getattr(args, "disaggregate", False)),
+            prefix_cache=bool(getattr(args, "prefix_cache", False)),
             measured_overlap=measured_overlap,
             preemption_rate_per_h=float(
                 getattr(args, "preemption_rate", None) or 0.0),
@@ -847,6 +848,9 @@ def cmd_check(args: argparse.Namespace) -> int:
             adapters=args.serve_adapters,
             adapter_rank=args.serve_adapter_rank,
             quant_adapters=args.serve_quant_adapters,
+            prefix_cache=bool(getattr(args, "serve_prefix_cache", False)),
+            expected_hit_rate=float(
+                getattr(args, "serve_prefix_hit_rate", None) or 0.0),
             params_bytes=params_bytes, **kwargs)
         findings += s_findings
     try:
@@ -884,6 +888,12 @@ def cmd_check(args: argparse.Namespace) -> int:
                      f"{'int8' if serve_est['quant_adapters'] else 'f32'} "
                      f"({serve_est['adapter_pool_bytes'] // 1024} KiB)"
                      if serve_est.get("n_adapters") else "") + ")")
+            if serve_est.get("prefix_cache"):
+                print(f"  prefix cache: index metadata "
+                      f"{serve_est['prefix_index_bytes'] // 1024} KiB; "
+                      f"at {serve_est['expected_hit_rate']:.0%} hit rate "
+                      f"~{serve_est['effective_max_streams']} effective "
+                      f"stream(s) (shared prefix blocks counted once)")
         print(f"tadnn check: {summary['errors']} error(s), "
               f"{summary['warnings']} warning(s)")
     return analysis.exit_code(findings, strict=args.strict)
@@ -977,6 +987,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
             speculative=args.speculative,
             mesh=mesh,
             disaggregate=bool(getattr(args, "disaggregate", False)),
+            prefix_cache=bool(getattr(args, "prefix_cache", False)),
             journal=jnl,
         )
         for i in range(n_adapters):
@@ -985,8 +996,14 @@ def cmd_serve(args: argparse.Namespace) -> int:
                 random_adapter(variables["params"], lora_spec,
                                seed=args.seed + 100 + i))
         streams = args.streams or 8
+        shared_len = max(0, min(
+            int(getattr(args, "shared_prefix", 0) or 0), prompt_len - 1))
+        shared = (rs.randint(1, cfg.vocab_size, size=(shared_len,))
+                  if shared_len else None)
         for j in range(streams):
             prompt = rs.randint(1, cfg.vocab_size, size=(prompt_len,))
+            if shared is not None:
+                prompt = np.concatenate([shared, prompt[shared_len:]])
             eng.submit([int(t) for t in prompt],
                        max_new_tokens=args.max_new or 12, eos_id=0,
                        adapter=(f"tenant{j % n_adapters}"
@@ -1031,6 +1048,19 @@ def cmd_serve(args: argparse.Namespace) -> int:
                 round(eng.spec_accepted / eng.spec_drafted, 4)
                 if eng.spec_drafted else None),
             "disaggregate": eng.disaggregate,
+            "prefix_cache": eng.prefix_cache is not None,
+            "prefix_hit_rate": (
+                round(eng.prefix_cached_tokens
+                      / max(1, sum(r.n_prompt for r in done)), 4)
+                if eng.prefix_cache is not None else None),
+            "prefix_hit_requests": (eng.prefix_hits
+                                    if eng.prefix_cache is not None
+                                    else None),
+            "prefix_saved_chunks": (eng.prefix_saved_chunks
+                                    if eng.prefix_cache is not None
+                                    else None),
+            "cow_forks": (eng.cow_forks
+                          if eng.prefix_cache is not None else None),
             "tp": serve_tp,
             "kv_ships": eng.pool.n_transfers,
             "shipped_blocks": eng.pool.transferred_blocks,
@@ -1063,6 +1093,25 @@ def cmd_export(args: argparse.Namespace) -> int:
     from .obs import journal as obs_journal_mod
 
     cache = export_cache_mod.resolve(args.cache or True)
+
+    if getattr(args, "gc", False):
+        from .obs.journal import Journal
+
+        days = getattr(args, "max_age_days", None)
+        days = 30.0 if days is None else float(days)
+        with Journal(args.journal, host0_only=False,
+                     meta={"tool": "export"}) as jnl:
+            with obs_journal_mod.as_default(jnl):
+                stats = cache.gc(days * 86400.0)
+        if args.json:
+            print(json.dumps({"cache": cache.root, **stats}))
+        else:
+            kb = stats["payload_bytes_freed"] // 1024
+            print(f"export cache: {cache.root}")
+            print(f"  gc: dropped {stats['dropped']}/{stats['scanned']} "
+                  f"entries not hit in {days:g} day(s) "
+                  f"({kb} KiB of payloads freed, {stats['kept']} kept)")
+        return 0
 
     if args.verify:
         report = cache.verify()
@@ -1346,7 +1395,9 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--traffic", default=None,
                    help="serving traffic mix, e.g. "
                         "'rate=16,n=64,prompt=128,max_new=128,decode=96"
-                        ",jitter=0.5,seed=0' (rate in req/s)")
+                        ",jitter=0.5,shared=0,seed=0' (rate in req/s; "
+                        "shared = leading prompt tokens common to every "
+                        "request, for --prefix-cache)")
     p.add_argument("--slo", default=None,
                    help="SLO spec, e.g. 'tok_s_chip>=40,p99_ms<=2500,"
                         "headroom>=0.1,survival>=0.9'")
@@ -1369,6 +1420,12 @@ def main(argv: list[str] | None = None) -> int:
                         "replicas: prefill on its own slice, KV blocks "
                         "shipped over DCN on multislice fleets, step "
                         "wall = max(prefill, decode)")
+    p.add_argument("--prefix-cache", action="store_true",
+                   dest="prefix_cache",
+                   help="price cross-request prefix reuse in the replay "
+                        "(a real PrefixCache over the virtual pool); "
+                        "pair with a shared= term in --traffic, e.g. "
+                        "'prompt=128,shared=112' — needs --prefill-chunk")
     p.add_argument("--measured-overlap", type=float, default=None,
                    dest="measured_overlap", metavar="FRAC",
                    help="measured exposed-collective fraction (0..1) "
@@ -1537,6 +1594,19 @@ def main(argv: list[str] | None = None) -> int:
                         "slots through the pool, and decode steps no "
                         "longer interleave prefill; token-identical to "
                         "colocated")
+    p.add_argument("--prefix-cache", action="store_true",
+                   dest="prefix_cache",
+                   help="cross-request prefix reuse: radix-index full "
+                        "prompt blocks by chained content hash; admitted "
+                        "requests skip prefill over their cached prefix "
+                        "(copy-on-write blocks, token-identical to "
+                        "cache-off; needs --prefill-chunk)")
+    p.add_argument("--shared-prefix", type=int, default=0,
+                   dest="shared_prefix", metavar="N",
+                   help="draw the first N prompt tokens once and share "
+                        "them across every stream (the traffic shape "
+                        "--prefix-cache exploits; capped at "
+                        "prompt_len - 1)")
     p.add_argument("--serve-tp", type=int, default=1, dest="serve_tp",
                    metavar="N",
                    help="tensor-parallel degree: shard KV-pool / "
@@ -1585,6 +1655,14 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--verify", action="store_true",
                    help="report which cache entries would load on this "
                         "host/version (live) and which are stale")
+    p.add_argument("--gc", action="store_true",
+                   help="garbage-collect by last-hit age: drop entries "
+                        "not deserialized within --max-age-days, delete "
+                        "their payloads and rewrite the index (every "
+                        "cache hit refreshes an entry's age)")
+    p.add_argument("--max-age-days", type=float, default=30.0,
+                   dest="max_age_days", metavar="N",
+                   help="--gc retention window in days (default 30)")
     p.add_argument("--slots", type=int, default=None,
                    help="--serve: decode slots")
     p.add_argument("--max-len", type=int, default=None, dest="max_len",
@@ -1757,6 +1835,16 @@ def main(argv: list[str] | None = None) -> int:
                         "adapter b factors and params all charge "
                         "per-device, so ML004/ML005/ML006 judge the "
                         "sharded deployment")
+    p.add_argument("--serve-prefix-cache", action="store_true",
+                   dest="serve_prefix_cache",
+                   help="charge the prefix-reuse radix index metadata "
+                        "and report effective concurrency when shared "
+                        "prefixes dedupe KV blocks")
+    p.add_argument("--serve-prefix-hit-rate", type=float, default=0.0,
+                   dest="serve_prefix_hit_rate", metavar="FRAC",
+                   help="expected fraction [0,1) of prompt tokens served "
+                        "from the prefix cache (sizes "
+                        "effective_max_streams; default 0)")
     p.add_argument("--zero1", action="store_true",
                    help="ZeRO-1 for --memory: shard optimizer moments "
                         "over the data axis (the per-chip optimizer row "

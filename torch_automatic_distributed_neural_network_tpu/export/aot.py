@@ -77,6 +77,10 @@ def cached_compile(fn: Any, abstract_args: Sequence[Any], *,
                     obs_journal.event(
                         "export.hit", kind=kind, key=key, deserialize_s=dt,
                         payload_bytes=rec.get("payload_bytes"))
+                    try:
+                        cache.touch(key)  # GC retention runs on last hit
+                    except Exception:
+                        pass  # read-only cache dir: hit still served
                     return ExportResult(
                         key, kind, "hit", compiled, deserialize_s=dt,
                         payload_bytes=rec.get("payload_bytes"))

@@ -232,6 +232,18 @@ class ExecutableCache:
         tune_cache.store(key, rec, path=self.index_path, max_bytes=0)
         self._maybe_compact()
 
+    def touch(self, key: str) -> None:
+        """Record a cache hit: re-append the entry's record with a
+        fresh ``last_hit`` timestamp.  GC evicts by last-hit age, so a
+        hot executable stays resident however old its compile is."""
+        rec = self.lookup(key)
+        if rec is None:
+            return
+        rec = dict(rec)
+        rec["last_hit"] = time.time()
+        tune_cache.store(key, rec, path=self.index_path, max_bytes=0)
+        self._maybe_compact()
+
     # -- maintenance ---------------------------------------------------------
 
     def _maybe_compact(self) -> None:
@@ -266,6 +278,47 @@ class ExecutableCache:
         from ..obs import journal as obs_journal
 
         obs_journal.event("export.compact", path=self.index_path, **stats)
+        return stats
+
+    def gc(self, max_age_s: float) -> dict:
+        """Drop every entry neither hit nor created within
+        ``max_age_s``: delete its payload file and rewrite the index
+        without it (``tadnn export --gc``).  Age is measured from the
+        latest ``last_hit`` (``touch`` on every deserialize) falling
+        back to ``created``, so anything still being loaded survives
+        indefinitely while one-off experiments age out.  Journals
+        ``export.gc``; returns the stats dict."""
+        now = time.time()
+        entries = self.entries()
+        keep: dict[str, dict] = {}
+        dropped = 0
+        freed = 0
+        for key, rec in entries.items():
+            ts = rec.get("last_hit") or rec.get("created") or 0.0
+            if now - float(ts) <= max_age_s:
+                keep[key] = rec
+                continue
+            dropped += 1
+            f = rec.get("file")
+            path = (os.path.join(self.root, f) if f
+                    else self.payload_path(key))
+            try:
+                freed += os.path.getsize(path)
+                os.remove(path)
+            except OSError:
+                pass
+        if dropped and os.path.isfile(self.index_path):
+            tmp = f"{self.index_path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                for key, rec in keep.items():
+                    f.write(json.dumps({"key": key, "record": rec}) + "\n")
+            os.replace(tmp, self.index_path)
+        stats = {"scanned": len(entries), "dropped": dropped,
+                 "kept": len(keep), "payload_bytes_freed": freed,
+                 "max_age_s": max_age_s}
+        from ..obs import journal as obs_journal
+
+        obs_journal.event("export.gc", path=self.index_path, **stats)
         return stats
 
     def verify(self) -> list[dict]:

@@ -10,6 +10,7 @@ from .adapters import (
     random_adapter,
 )
 from .engine import ServeEngine
+from .prefix_cache import PrefixCache, block_hashes
 from .kv_pool import (
     NULL_BLOCK,
     BlockAllocator,
@@ -36,10 +37,12 @@ __all__ = [
     "AdapterPool",
     "BlockAllocator",
     "PagedKVPool",
+    "PrefixCache",
     "Request",
     "Scheduler",
     "ServeEngine",
     "admission_plan",
+    "block_hashes",
     "blocks_at_admission",
     "blocks_for_tokens",
     "decode_needs_block",

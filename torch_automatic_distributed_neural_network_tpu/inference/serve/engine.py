@@ -98,8 +98,8 @@ from ..decode import (
     _sample,
     forward_cached,
 )
-from ..quant import dequantize_leaf, dequantize_tree, embedding_lookup, \
-    is_quantized_leaf
+from ..quant import dequantize_kv, dequantize_leaf, dequantize_tree, \
+    embedding_lookup, is_quantized_leaf, quantize_kv
 from ..speculative import accept_length, ngram_propose
 from .adapters import IDENTITY_ADAPTER, AdapterPool, factor_rows
 from .kv_pool import (
@@ -108,6 +108,7 @@ from .kv_pool import (
     gather_blocks,
     write_token,
 )
+from .prefix_cache import PrefixCache
 from .scheduler import Request, Scheduler
 
 
@@ -266,7 +267,8 @@ def _paged_decode_step(params, kv, tables, ctx_lens, tok, active,
 
 
 def _prefill_chunk_step(params, tokens, cache, last_idx, *,
-                        cfg: TransformerConfig, moe_decode: str):
+                        cfg: TransformerConfig, moe_decode: str,
+                        quantize: bool = False):
     """One fixed-shape prefill chunk: [1, C] tokens through
     ``forward_cached`` against the fixed [1, max_len] temp cache.
 
@@ -276,37 +278,79 @@ def _prefill_chunk_step(params, tokens, cache, last_idx, *,
     be right-padded; ``last_idx`` selects the last REAL token's logits,
     and causal masking keeps the pad positions (which sit after it) out
     of that row entirely.
+
+    ``quantize=True`` (int8 KV pools) round-trips the chunk's fresh
+    cache rows through the pool's (q, scale) representation before the
+    next chunk attends to them, and ALSO returns that quantized chunk
+    so the commit scatters the exact same (q, scale) pairs — no second
+    quantization.  The point is a single KV representation everywhere:
+    later prefill chunks, decode, and any future request that reuses
+    these rows through the prefix cache all see bit-identical values,
+    which is what makes cache-on vs cache-off token parity exact in
+    int8 mode instead of merely close.
     """
+    pos0 = cache.length
     logits, cache = forward_cached(
         params, cfg, tokens, cache, moe_decode=moe_decode, mesh=None,
         all_logits=True)
     last = jax.lax.dynamic_index_in_dim(
         logits, last_idx, axis=1, keepdims=False)
-    return last, cache
+    if not quantize:
+        return last, cache
+    T = tokens.shape[1]
+    k_rows = jax.lax.dynamic_slice_in_dim(
+        cache.k, pos0, T, axis=2)[:, 0]  # [L, T, kvH, hd]
+    v_rows = jax.lax.dynamic_slice_in_dim(cache.v, pos0, T, axis=2)[:, 0]
+    qk, qv = quantize_kv(k_rows), quantize_kv(v_rows)
+    cache = cache._replace(
+        k=jax.lax.dynamic_update_slice_in_dim(
+            cache.k, dequantize_kv(qk, cache.k.dtype)[:, None],
+            pos0, axis=2),
+        v=jax.lax.dynamic_update_slice_in_dim(
+            cache.v, dequantize_kv(qv, cache.v.dtype)[:, None],
+            pos0, axis=2))
+    return last, cache, {"k": qk, "v": qv}
 
 
 def _prefill_chunk_lora_step(params, lora, tokens, cache, last_idx, *,
                              cfg: TransformerConfig, moe_decode: str,
-                             lora_spec: LoraSpec):
+                             lora_spec: LoraSpec, quantize: bool = False):
     """Chunked prefill through per-tenant merged weights: ``merge_lora``
     runs INSIDE the jit (the rank-r matmul fuses into the weight load),
     so ONE trace serves every tenant — the factor tree is a traced
     operand and the merged weights never materialize on the host."""
     merged = merge_lora(params, lora, lora_spec)
     return _prefill_chunk_step(merged, tokens, cache, last_idx,
-                               cfg=cfg, moe_decode=moe_decode)
+                               cfg=cfg, moe_decode=moe_decode,
+                               quantize=quantize)
+
+
+def _cat_qchunks(qchunks: list, n_tokens: int):
+    """Concatenate the prefill trace's per-chunk quantized KV along the
+    token axis and trim the final chunk's pad rows: two ``{"q",
+    "scale"}`` leaves of [L, n_tokens, kvH, *], ready for
+    ``write_prefill`` to scatter without re-quantizing."""
+    out = []
+    for side in ("k", "v"):
+        q = jnp.concatenate([c[side]["q"] for c in qchunks], axis=1)
+        s = jnp.concatenate([c[side]["scale"] for c in qchunks], axis=1)
+        out.append({"q": q[:, :n_tokens], "scale": s[:, :n_tokens]})
+    return out[0], out[1]
 
 
 @dataclasses.dataclass
 class _PrefillState:
     """Host-side cursor of one in-flight chunked prefill: the [1,
     max_len] temp cache being filled, how many prompt tokens have
-    streamed through it so far, and the tenant's factor tree (None for
-    base-model requests)."""
+    streamed through it so far (a prefix-cache hit starts the cursor
+    past the reused rows), the tenant's factor tree (None for
+    base-model requests), and — int8 pools only — the per-chunk
+    (q, scale) pairs the commit will scatter verbatim."""
 
     cache: KVCache
     pos: int = 0
     lora: Any = None
+    qchunks: list = dataclasses.field(default_factory=list)
 
 
 class ServeEngine:
@@ -340,6 +384,7 @@ class ServeEngine:
                  n_adapters: int = 8,
                  quant_adapters: bool = False,
                  speculative: int = 0,
+                 prefix_cache: bool = False,
                  mesh=None,
                  disaggregate: bool = False,
                  rng: jax.Array | None = None,
@@ -396,11 +441,44 @@ class ServeEngine:
             self.adapter_pool = AdapterPool(
                 self.params, lora_spec, n_adapters=n_adapters,
                 quantize=quant_adapters, mesh=mesh)
+        # cross-request prefix caching: radix index over resident
+        # prompt-prefix blocks; matched prefixes are ref'd into the new
+        # request's table and their chunks skipped.  Chunked-prefill
+        # only: the reuse path seeds the chunk trace's temp cache.
+        # Match alignment: block granularity in fp mode; in int8 mode
+        # additionally snapped to prefill-chunk boundaries, so the
+        # cache-off run's chunk partition of the recomputed suffix is
+        # reproduced exactly (bit-identical tokens either way).
+        self._prefix_cache = None
+        match_align = None
+        if prefix_cache:
+            if prefill_chunk is None:
+                raise ValueError(
+                    "prefix_cache requires chunked prefill "
+                    "(prefill_chunk=None is the legacy single-shot "
+                    "path, which cannot resume from a cached prefix)")
+            self._prefix_cache = PrefixCache(
+                block_size=block_size, allocator=self.pool.allocator)
+            match_align = (math.lcm(block_size, self.prefill_chunk)
+                           if quant_kv else block_size)
+            # pre-compile the hit-seeding reads (fixed shapes compile
+            # exactly once) so the first matched request doesn't pay
+            # them inside its prefill window
+            kd, vd = self.pool.read_blocks(
+                [], self.max_blocks, dtype=jnp.bfloat16)
+            jax.block_until_ready(
+                (kd[:, None, :max_len], vd[:, None, :max_len]))
+        self.prefix_queries = 0
+        self.prefix_hits = 0
+        self.prefix_cached_tokens = 0
+        self.prefix_saved_chunks = 0
+        self.cow_forks = 0
         self.scheduler = Scheduler(
             n_slots=n_slots, allocator=self.pool.allocator,
             block_size=block_size, admission=admission,
             adapter_pool=self.adapter_pool,
-            spec_lookahead=self.speculative)
+            spec_lookahead=self.speculative,
+            prefix_cache=self._prefix_cache, match_align=match_align)
         self.journal = journal or _journal.get_default()
         self._rng = jax.random.key(0) if rng is None else rng
         self._step_count = 0
@@ -423,12 +501,13 @@ class ServeEngine:
             donate_argnums=(1,))
         self._prefill_fn = jax.jit(
             partial(_prefill_chunk_step, cfg=self.cfg,
-                    moe_decode=moe_decode))
+                    moe_decode=moe_decode, quantize=bool(quant_kv)))
         self._prefill_lora_fn = None
         if lora_spec is not None:
             self._prefill_lora_fn = jax.jit(
                 partial(_prefill_chunk_lora_step, cfg=self.cfg,
-                        moe_decode=moe_decode, lora_spec=lora_spec))
+                        moe_decode=moe_decode, lora_spec=lora_spec,
+                        quantize=bool(quant_kv)))
         # AOT executable cache (export/): replica spin-up goes
         # cache-first on the two fixed-shape serve traces, so a warm
         # replica deserializes the decode step and the prefill chunk
@@ -456,6 +535,7 @@ class ServeEngine:
                 adapter_rank=(lora_spec.rank if lora_spec else None),
                 quant_adapters=bool(quant_adapters and lora_spec),
                 speculative=self.speculative,
+                prefix_cache=self._prefix_cache is not None,
                 disaggregate=self.disaggregate,
                 tp=tensor_degree(mesh))
 
@@ -490,6 +570,10 @@ class ServeEngine:
             "cache_dtype": str(np.dtype(cache_dtype)),
             "sample": dataclasses.asdict(self.sample),
             "prefill_chunk": self.prefill_chunk,
+            # int8 chunked prefill round-trips + returns (q, scale)
+            # chunks — a different program than the pre-prefix-cache
+            # trace, so quantized engines must not load stale payloads
+            **({"prefill_q_commit": True} if quant_kv else {}),
             "lora": ([self.lora_spec.rank, self.lora_spec.scaling,
                       n_adapters, quant_adapters]
                      if self.lora_spec is not None else None),
@@ -607,24 +691,42 @@ class ServeEngine:
         return self.adapter_pool.effective_lora(req.adapter)
 
     def _commit_prefill(self, slot: int, req: Request,
-                        k: jax.Array, v: jax.Array) -> None:
-        """Land a finished prefill's dense cache rows in the request's
-        blocks.  Colocated mode writes in place; disaggregated mode
-        routes through ``pool.ship_prefill`` — same payload, plus the
+                        k: Any, v: Any) -> None:
+        """Land a finished prefill's computed cache rows in the
+        request's blocks — only the UNCACHED suffix: rows ``k``/``v``
+        start at token ``req.cached_tokens`` (a prefix-cache hit's
+        reused blocks already hold their KV and are never rewritten).
+        Colocated mode writes in place; disaggregated mode routes
+        through ``pool.ship_prefill`` — same payload, plus the
         block/byte transfer accounting that becomes DCN traffic when
         the prefill slice is a distinct pod slice — and journals the
-        shipment."""
-        blocks = req.blocks[:blocks_for_tokens(
-            req.n_prompt, self.pool.block_size)]
+        shipment.  Afterwards the request's full prompt blocks are
+        published into the radix index (for disaggregated serving that
+        IS ship time: a block is only advertised for reuse once it is
+        resident in the decode slice's pool)."""
+        full = blocks_for_tokens(req.n_prompt, self.pool.block_size)
+        blocks = req.blocks[req.cached_blocks:full]
         if not self.disaggregate:
             self.pool.write_prefill(blocks, k, v)
-            return
-        moved = self.pool.ship_prefill(blocks, k, v)
-        self.scheduler.record_ship(slot, len(blocks))
-        if self.journal is not None:
-            self.journal.event(
-                "serve.kv_ship", rid=req.rid, slot=slot,
-                n_blocks=len(blocks), bytes=moved)
+        else:
+            moved = self.pool.ship_prefill(blocks, k, v)
+            self.scheduler.record_ship(slot, len(blocks))
+            if self.journal is not None:
+                self.journal.event(
+                    "serve.kv_ship", rid=req.rid, slot=slot,
+                    n_blocks=len(blocks), bytes=moved)
+        if self._prefix_cache is not None:
+            # publish every FULL prompt block: decode writes start at
+            # position n_prompt, so these rows are immutable (CoW
+            # guards the manufactured-sharing corner regardless)
+            n_pub = req.n_prompt // self.pool.block_size
+            new = self._prefix_cache.insert(
+                req.prompt[:n_pub * self.pool.block_size],
+                req.blocks[:n_pub])
+            if new and self.journal is not None:
+                self.journal.event(
+                    "serve.prefix", kind="publish", rid=req.rid,
+                    n_blocks=new)
 
     def _prefill_into_slot(self, slot: int, req: Request) -> None:
         tokens = jnp.asarray(req.prompt, jnp.int32)[None, :]
@@ -649,7 +751,13 @@ class ServeEngine:
     def _start_prefill(self, slot: int, req: Request) -> None:
         """Admission entry point: legacy single-shot prefill, or flip
         the slot to "prefilling" so step() streams the prompt through
-        the shared chunk trace, interleaved with decode."""
+        the shared chunk trace, interleaved with decode.
+
+        A prefix-cache hit seeds the temp cache by reading the matched
+        blocks' KV back from the pool (``pool.read_blocks``) and starts
+        the cursor after them — the chunk trace then computes only the
+        uncached suffix, attending to the reused rows exactly as the
+        original prefill's later chunks attended to them."""
         if self.prefill_chunk is None:
             # single-shot requests go straight to running, so the pin
             # happens here (before the prefill work, cheaply bounced)
@@ -658,9 +766,32 @@ class ServeEngine:
             self._prefill_into_slot(slot, req)
             return
         req.state = "prefilling"
+        cache = KVCache.init(self.cfg, 1, self.max_len,
+                             dtype=jnp.bfloat16)
+        if self._prefix_cache is not None:
+            self.prefix_queries += 1
+            if req.cached_tokens:
+                self.prefix_hits += 1
+                self.prefix_cached_tokens += req.cached_tokens
+                C = self.prefill_chunk
+                self.prefix_saved_chunks += (
+                    -(-req.n_prompt // C)
+                    - -(-(req.n_prompt - req.cached_tokens) // C))
+                kd, vd = self.pool.read_blocks(
+                    req.blocks[:req.cached_blocks], self.max_blocks,
+                    dtype=cache.k.dtype)
+                cache = cache._replace(
+                    k=kd[:, None, :self.max_len],
+                    v=vd[:, None, :self.max_len],
+                    length=jnp.asarray(req.cached_tokens, jnp.int32))
+            if self.journal is not None:
+                self.journal.event(
+                    "serve.prefix", kind="match", rid=req.rid,
+                    hit=bool(req.cached_tokens),
+                    cached_tokens=req.cached_tokens,
+                    cached_blocks=req.cached_blocks)
         self._prefill[req.rid] = _PrefillState(
-            cache=KVCache.init(self.cfg, 1, self.max_len,
-                               dtype=jnp.bfloat16),
+            cache=cache, pos=req.cached_tokens,
             lora=self._req_lora(req))
 
     def _advance_prefill(self, slot: int, req: Request) -> None:
@@ -678,12 +809,16 @@ class ServeEngine:
         # np.int32, not a weak-typed python int: the AOT-exported trace
         # pins the cursor's dtype, and jit would silently retrace
         last_idx = np.int32(n_real - 1)
-        if st.lora is None:
-            logits, st.cache = self._prefill_fn(
-                self.params, tokens, st.cache, last_idx)
-        else:
-            logits, st.cache = self._prefill_lora_fn(
+        fn, args = self._prefill_fn, (self.params, tokens, st.cache,
+                                      last_idx)
+        if st.lora is not None:
+            fn, args = self._prefill_lora_fn, (
                 self.params, st.lora, tokens, st.cache, last_idx)
+        if self.pool.quantize:
+            logits, st.cache, qchunk = fn(*args)
+            st.qchunks.append(qchunk)
+        else:
+            logits, st.cache = fn(*args)
         st.pos += n_real
         done = st.pos >= req.n_prompt
         bounced = done and not self._bind_adapter(slot, req)
@@ -692,9 +827,18 @@ class ServeEngine:
             _, first_rng = jax.random.split(req_rng)
             first = int(jax.device_get(
                 _sample(logits, first_rng, self.sample))[0])
-            self._commit_prefill(slot, req,
-                                 st.cache.k[:, 0, :req.n_prompt],
-                                 st.cache.v[:, 0, :req.n_prompt])
+            n_suffix = req.n_prompt - req.cached_tokens
+            if self.pool.quantize:
+                # commit the trace's own (q, scale) chunks verbatim —
+                # re-quantizing the round-tripped rows would not be
+                # idempotent through a bf16 temp cache
+                k_rows, v_rows = _cat_qchunks(st.qchunks, n_suffix)
+            else:
+                k_rows = st.cache.k[:, 0,
+                                    req.cached_tokens:req.n_prompt]
+                v_rows = st.cache.v[:, 0,
+                                    req.cached_tokens:req.n_prompt]
+            self._commit_prefill(slot, req, k_rows, v_rows)
             req.out_tokens = [first]
             req.t_first_token = time.monotonic()
             req.state = "running"
@@ -705,6 +849,45 @@ class ServeEngine:
                 pos=min(st.pos, req.n_prompt), n_tokens=n_real,
                 seconds=time.monotonic() - t0,
                 done=bool(done and not bounced))
+
+    def _cow_fork_writes(self) -> None:
+        """Copy-on-write guard, run right before the decode step: any
+        block this step will WRITE into (positions ctx..ctx+lookahead)
+        that is shared (refcount > 1 — some other table or the radix
+        index also points at it) is forked to a private copy first, so
+        the write can never corrupt another owner's view.  In natural
+        traffic this never fires — matches are capped below the prompt
+        end and published blocks sit strictly before the first decode
+        write — but the guard makes sharing safe by construction, not
+        by traffic shape."""
+        bs = self.pool.block_size
+        alloc = self.pool.allocator
+        for req in self.scheduler.slots:
+            if req is None or req.state != "running":
+                continue
+            ctx = req.n_prompt + req.n_generated - 1
+            for t in range(1 + self.speculative):
+                bi = (ctx + t) // bs
+                if bi >= len(req.blocks):
+                    break  # optimistic growth handles coverage
+                b = req.blocks[bi]
+                if alloc.refcount(b) <= 1:
+                    continue
+                nb = self.pool.fork_block(b)
+                if (nb is None and self._prefix_cache is not None
+                        and self._prefix_cache.evict(1)):
+                    nb = self.pool.fork_block(b)
+                if nb is None:
+                    raise RuntimeError(
+                        f"cannot fork shared block {b}: pool exhausted "
+                        f"and no evictable index leaf")
+                req.blocks[bi] = nb
+                alloc.release([b])
+                self.cow_forks += 1
+                if self.journal is not None:
+                    self.journal.event(
+                        "serve.prefix", kind="cow", rid=req.rid,
+                        block=b, fork=nb)
 
     def _decode_all(self) -> None:
         S, MB = self.n_slots, self.max_blocks
@@ -826,6 +1009,8 @@ class ServeEngine:
                                    n_regenerate=victim.n_prompt)
         decode_s = 0.0
         if sched.n_decoding:
+            if self._prefix_cache is not None:
+                self._cow_fork_writes()
             t0 = time.monotonic()
             self._decode_all()
             decode_s = time.monotonic() - t0
@@ -845,6 +1030,10 @@ class ServeEngine:
                 adapter_stats = dict(
                     adapters_resident=alloc.n_resident,
                     adapters_pinned=alloc.n_pinned)
+            if self._prefix_cache is not None:
+                adapter_stats.update(
+                    prefix_blocks=self._prefix_cache.n_blocks,
+                    prefix_hit_tokens=self._prefix_cache.hit_tokens)
             self.journal.event(
                 "serve.step", step=self._step_count,
                 n_active=sched.n_active, n_queued=sched.n_queued,
@@ -856,6 +1045,11 @@ class ServeEngine:
                       else "colocated"),
                 overlap_s=overlap_s,
                 **adapter_stats)
+
+    @property
+    def prefix_cache(self) -> PrefixCache | None:
+        """The engine's radix reuse index (None when disabled)."""
+        return self._prefix_cache
 
     @property
     def mean_occupancy(self) -> float | None:

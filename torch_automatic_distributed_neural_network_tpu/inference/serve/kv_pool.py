@@ -57,14 +57,21 @@ def blocks_for_tokens(n_tokens: int, block_size: int) -> int:
 
 
 class BlockAllocator:
-    """Free-list allocator over ``num_blocks`` block ids.
+    """Ref-counted free-list allocator over ``num_blocks`` block ids.
 
     Block 0 (:data:`NULL_BLOCK`) is reserved and never handed out.
-    ``alloc`` is all-or-nothing (returns None rather than a partial
-    grant — admission control wants a clean fit check), ``free`` rejects
-    double-frees and foreign ids loudly: a block on two tables at once
-    is silent cross-request cache corruption, the one failure mode a
-    paged cache must make impossible.
+    ``acquire`` is all-or-nothing (returns None rather than a partial
+    grant — admission control wants a clean fit check) and hands out
+    blocks at refcount 1; ``ref`` adds a reference so a block can back
+    several owners at once (cross-request prefix sharing: many block
+    tables plus the radix index may all point at one block);
+    ``release`` decrements and returns the block to the free list only
+    at refcount 0.  A release of a block with no outstanding reference
+    still raises loudly — a double-release from the same owner is the
+    refcount-era shape of the double-free bug, and silent over-release
+    is cross-request cache corruption, the one failure mode a paged
+    cache must make impossible.  ``alloc``/``free`` remain as aliases
+    for the single-owner call sites.
     """
 
     def __init__(self, num_blocks: int):
@@ -76,7 +83,7 @@ class BlockAllocator:
         # LIFO free list: recently-freed blocks are re-used first (their
         # pool pages are the ones still warm in cache on real hardware)
         self._free = list(range(num_blocks - 1, 0, -1))
-        self._live: set[int] = set()
+        self._refs: dict[int, int] = {}
 
     @property
     def n_free(self) -> int:
@@ -84,26 +91,52 @@ class BlockAllocator:
 
     @property
     def n_live(self) -> int:
-        return len(self._live)
+        return len(self._refs)
 
-    def alloc(self, n: int) -> list[int] | None:
-        """``n`` block ids, or None if the pool cannot cover them."""
+    @property
+    def _live(self) -> set[int]:
+        """Live block ids (refcount >= 1) — invariant-check view."""
+        return set(self._refs)
+
+    def refcount(self, block: int) -> int:
+        """Outstanding references on ``block`` (0 when free)."""
+        return self._refs.get(block, 0)
+
+    def acquire(self, n: int) -> list[int] | None:
+        """``n`` fresh block ids at refcount 1, or None if the pool
+        cannot cover them."""
         if n < 0:
-            raise ValueError(f"alloc({n})")
+            raise ValueError(f"acquire({n})")
         if n > len(self._free):
             return None
         got = [self._free.pop() for _ in range(n)]
-        self._live.update(got)
+        for b in got:
+            self._refs[b] = 1
         return got
 
-    def free(self, blocks: list[int]) -> None:
+    def ref(self, block: int) -> None:
+        """Add a reference to an already-live block (a new owner)."""
+        if block not in self._refs:
+            raise ValueError(
+                f"ref of block {block} not currently allocated")
+        self._refs[block] += 1
+
+    def release(self, blocks: list[int]) -> None:
         for b in blocks:
-            if b not in self._live:
+            n = self._refs.get(b, 0)
+            if n <= 0:
                 raise ValueError(
-                    f"free of block {b} not currently allocated "
-                    f"(double-free or foreign id)")
-            self._live.remove(b)
-            self._free.append(b)
+                    f"release of block {b} with no outstanding "
+                    f"reference (double-free or foreign id)")
+            if n == 1:
+                del self._refs[b]
+                self._free.append(b)
+            else:
+                self._refs[b] = n - 1
+
+    # single-owner aliases (the pre-refcount API)
+    alloc = acquire
+    free = release
 
 
 def pool_kv_bytes(cfg: TransformerConfig, num_blocks: int, block_size: int,
@@ -251,6 +284,52 @@ class PagedKVPool:
     def free(self, blocks: list[int]) -> None:
         self.allocator.free(blocks)
 
+    def fork_block(self, src: int) -> int | None:
+        """Copy-on-write fork: acquire a fresh block, copy ``src``'s
+        device content into it, return the new id (None when the pool
+        is exhausted — the caller must evict or preempt first).  The
+        caller owns the table update and the release of its reference
+        on ``src``; the copy itself is one fused per-leaf scatter, no
+        host round-trip."""
+        got = self.allocator.acquire(1)
+        if got is None:
+            return None
+        dst = got[0]
+        for side, leaf in self.kv.items():
+            if is_quantized_leaf(leaf):
+                self.kv[side] = {
+                    "q": leaf["q"].at[:, dst].set(leaf["q"][:, src]),
+                    "scale": leaf["scale"].at[:, dst].set(
+                        leaf["scale"][:, src]),
+                }
+            else:
+                self.kv[side] = leaf.at[:, dst].set(leaf[:, src])
+        return dst
+
+    def read_blocks(self, blocks: list[int], max_blocks: int,
+                    dtype=jnp.bfloat16) -> tuple[jax.Array, jax.Array]:
+        """Dense dequantized view of a block list, padded to a fixed
+        width: (k, v) each ``[L, max_blocks * bs, kvH, hd]``.  This is
+        the prefix-cache seeding path — a matched prompt prefix reads
+        its resident KV back into the [1, max_len] prefill temp cache
+        instead of recomputing it.  The fixed ``max_blocks`` width
+        (rows past the real blocks gather null-block garbage the
+        cursor/mask never admits before they are overwritten) keeps the
+        op's shape constant, so it compiles once per engine config."""
+        table = jnp.asarray(self.table_row(blocks, max_blocks), jnp.int32)
+        out = []
+        for side in ("k", "v"):
+            payload, scale = kv_leaf_parts(self.kv[side])
+            g = jnp.take(payload, table, axis=1)  # [L, MB, bs, H, hd]
+            if scale is not None:
+                g = (g.astype(jnp.float32)
+                     * jnp.take(scale, table, axis=1)).astype(dtype)
+            elif g.dtype != dtype:
+                g = g.astype(dtype)
+            L, MB, bs, H, hd = g.shape
+            out.append(g.reshape(L, MB * bs, H, hd))
+        return out[0], out[1]
+
     def table_row(self, blocks: list[int], max_blocks: int) -> list[int]:
         """Fixed-width table row: allocated ids then null padding."""
         if len(blocks) > max_blocks:
@@ -263,11 +342,26 @@ class PagedKVPool:
         """Copy a dense prefill cache slice into allocated blocks.
 
         ``k``/``v``: [L, P, kvH, hd] (the batch-1 prefill cache row,
-        squeezed).  P is right-padded with zeros to a whole number of
-        blocks here; the pad cells are dead until the decode steps that
-        overwrite them, and the mask excludes them meanwhile.
+        squeezed) — or, in int8 mode, the already-quantized
+        ``{"q", "scale"}`` form of those rows: the chunked prefill
+        trace quantizes each chunk as it lands in the temp cache, and
+        committing those exact (q, scale) pairs (instead of
+        re-quantizing the dequantized rows) is what makes a
+        prefix-cache read-back bit-identical to the rows the original
+        prefill attended to.  P is right-padded with zeros to a whole
+        number of blocks here; the pad cells are dead until the decode
+        steps that overwrite them, and the mask excludes them
+        meanwhile.
         """
-        L, P, H, hd = k.shape
+        if is_quantized_leaf(k) != is_quantized_leaf(v):
+            raise ValueError("k/v must both be dense or both quantized")
+        if is_quantized_leaf(k):
+            if not self.quantize:
+                raise ValueError(
+                    "quantized prefill rows into a dense pool")
+            L, P, H, hd = k["q"].shape
+        else:
+            L, P, H, hd = k.shape
         n = len(blocks)
         pad = n * self.block_size - P
         if pad < 0:
@@ -276,19 +370,29 @@ class PagedKVPool:
                 f"{blocks_for_tokens(P, self.block_size)} blocks, "
                 f"got {n}")
         idx = jnp.asarray(blocks, jnp.int32)
-        for side, dense in (("k", k), ("v", v)):
-            x = jnp.pad(dense, ((0, 0), (0, pad), (0, 0), (0, 0)))
-            view = x.reshape(L, n, self.block_size, H, hd)
+
+        def blocked(x, fill=0):
+            x = jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)),
+                        constant_values=fill)
+            return x.reshape(L, n, self.block_size, H, x.shape[-1])
+
+        for side, rows in (("k", k), ("v", v)):
             leaf = self.kv[side]
-            if self.quantize:
-                q = quantize_kv(view)
+            if is_quantized_leaf(rows):
+                self.kv[side] = {
+                    "q": leaf["q"].at[:, idx].set(blocked(rows["q"])),
+                    "scale": leaf["scale"].at[:, idx].set(
+                        blocked(rows["scale"], fill=1)),
+                }
+            elif self.quantize:
+                q = quantize_kv(blocked(rows))
                 self.kv[side] = {
                     "q": leaf["q"].at[:, idx].set(q["q"]),
                     "scale": leaf["scale"].at[:, idx].set(q["scale"]),
                 }
             else:
                 self.kv[side] = leaf.at[:, idx].set(
-                    view.astype(leaf.dtype))
+                    blocked(rows).astype(leaf.dtype))
 
     def ship_prefill(self, blocks: list[int], k: jax.Array,
                      v: jax.Array) -> int:

@@ -52,7 +52,7 @@ from collections import deque
 from typing import Any, Callable, Sequence
 
 from .adapters import IDENTITY_ADAPTER
-from .kv_pool import BlockAllocator, blocks_for_tokens
+from .kv_pool import NULL_BLOCK, BlockAllocator, blocks_for_tokens
 
 _rid_counter = itertools.count()
 
@@ -84,25 +84,35 @@ def blocks_at_admission(n_prompt: int, max_new_tokens: int, *,
     return blocks_for_tokens(n_prompt, block_size)
 
 
-def admission_plan(queued: Sequence[tuple[int, int]], n_free_slots: int,
+def admission_plan(queued: Sequence[tuple], n_free_slots: int,
                    n_free_blocks: int, *, block_size: int, admission: str,
-                   spec_lookahead: int = 0) -> int:
+                   spec_lookahead: int = 0, n_evictable: int = 0) -> int:
     """How many queue-front requests to admit this step.
 
     ``queued`` is the FIFO queue as ``(n_prompt, max_new_tokens)``
-    pairs.  Walks the front while a free slot remains and the pool
+    pairs — or, under prefix caching, ``(n_prompt, max_new_tokens,
+    n_cached_tokens)`` triples: blocks covered by a prefix-cache match
+    are shared references into already-resident KV, so admission
+    charges only the UNCACHED remainder against the free list.
+    ``n_evictable`` extends the block budget by what the radix index
+    can reclaim on demand (unreferenced leaves) — the scheduler drops
+    those before ever preempting a live slot, so planning against them
+    is sound.  Walks the front while a free slot remains and the pool
     covers the fit check; stops at the FIRST request that does not fit
     (strict FIFO — later, possibly smaller, requests wait rather than
     jump the queue).
     """
     n_admit = 0
-    free = int(n_free_blocks)
-    for n_prompt, max_new in queued:
+    free = int(n_free_blocks) + int(n_evictable)
+    for item in queued:
+        n_prompt, max_new = item[0], item[1]
+        cached_tokens = item[2] if len(item) > 2 else 0
         if n_admit >= n_free_slots:
             break
         need = blocks_at_admission(
             n_prompt, max_new, block_size=block_size,
             admission=admission, spec_lookahead=spec_lookahead)
+        need -= cached_tokens // block_size
         if need > free:
             break
         free -= need
@@ -174,6 +184,17 @@ class Request:
     blocks: list[int] = dataclasses.field(default_factory=list)
     out_tokens: list[int] = dataclasses.field(default_factory=list)
     preempted: int = 0
+    # prefix-cache accounting, set at admission: the first
+    # ``cached_blocks`` entries of ``blocks`` are SHARED references
+    # into KV an earlier request computed (covering ``cached_tokens``
+    # prompt tokens) — prefill starts after them and commit skips them
+    cached_blocks: int = 0
+    cached_tokens: int = 0
+    # memoized chained block hashes of the prompt (admission planning
+    # re-matches every queued request every step; the prompt is
+    # immutable, so hash it once)
+    _prefix_keys: list | None = dataclasses.field(
+        default=None, repr=False, compare=False)
 
     # wall-clock marks for the serve.request span fields
     t_submit: float = dataclasses.field(default_factory=time.monotonic)
@@ -209,6 +230,7 @@ class Scheduler:
     def __init__(self, *, n_slots: int, allocator: BlockAllocator,
                  block_size: int, admission: str = "reserve",
                  adapter_pool=None, spec_lookahead: int = 0,
+                 prefix_cache=None, match_align: int | None = None,
                  clock: Callable[[], float] = time.monotonic):
         if admission not in ("reserve", "optimistic"):
             raise ValueError(f"unknown admission policy {admission!r}")
@@ -217,6 +239,19 @@ class Scheduler:
         self.block_size = block_size
         self.admission = admission
         self.adapter_pool = adapter_pool
+        # cross-request prefix reuse (prefix_cache.PrefixCache): matched
+        # prompt blocks are ref'd into the table instead of allocated,
+        # admission charges only the uncached remainder, and index
+        # leaves are evicted before any live slot is preempted.
+        # ``match_align`` floors a match to a multiple of this many
+        # tokens (>= block_size; the engine passes the prefill-chunk
+        # lcm in int8 mode so reuse stays bit-exact)
+        self.prefix_cache = prefix_cache
+        self.match_align = int(match_align or block_size)
+        if self.match_align % block_size:
+            raise ValueError(
+                f"match_align {self.match_align} must be a multiple of "
+                f"block_size {block_size}")
         # speculative decode writes up to `spec_lookahead` extra KV
         # positions per step — block coverage must lead by that much
         self.spec_lookahead = int(spec_lookahead)
@@ -259,23 +294,43 @@ class Scheduler:
     def check_invariants(self) -> None:
         """Structural invariants; raises AssertionError on violation.
 
-        Cheap enough to run every test step: no block on two live
-        tables, no live request holding the null block, allocator live
-        set == union of slot tables, free+live == num_blocks-1.
+        Cheap enough to run every test step.  Refcount discipline (the
+        multiset extension of the old no-block-on-two-tables rule): a
+        block appears at most once PER table, and its allocator
+        refcount equals the number of tables holding it plus one if the
+        radix index holds it — sharing is accounted, never implicit.
+        Also: no live request holds the null block, the live set is
+        exactly (tables union index), and free + live == num_blocks-1.
         """
-        seen: set[int] = set()
+        table_count: dict[int, int] = {}
         for r in self.slots:
             if r is None:
                 continue
+            mine: set[int] = set()
             for b in r.blocks:
-                assert b != 0, f"request {r.rid} holds the null block"
-                assert b not in seen, f"block {b} on two live tables"
-                seen.add(b)
-        assert seen == self.allocator._live, (
+                assert b != NULL_BLOCK, (
+                    f"request {r.rid} holds the null block")
+                assert b not in mine, (
+                    f"block {b} twice on request {r.rid}'s table")
+                mine.add(b)
+                table_count[b] = table_count.get(b, 0) + 1
+            assert r.cached_blocks <= len(r.blocks)
+        index_blocks = (self.prefix_cache.blocks()
+                        if self.prefix_cache is not None else set())
+        assert NULL_BLOCK not in index_blocks, (
+            "radix index holds the null block")
+        live = set(table_count) | index_blocks
+        assert live == self.allocator._live, (
             f"allocator live set {sorted(self.allocator._live)} != "
-            f"slot tables {sorted(seen)}")
-        assert (self.allocator.n_free + len(seen)
+            f"tables+index {sorted(live)}")
+        assert (self.allocator.n_free + len(live)
                 == self.allocator.num_blocks - 1), "block leak"
+        for b in live:
+            want = table_count.get(b, 0) + (1 if b in index_blocks else 0)
+            assert self.allocator.refcount(b) == want, (
+                f"block {b}: refcount {self.allocator.refcount(b)} != "
+                f"{table_count.get(b, 0)} table holders "
+                f"+ {int(b in index_blocks)} index reference")
         for r in self.queue:
             assert not r.blocks, (
                 f"queued request {r.rid} still holds blocks")
@@ -356,6 +411,7 @@ class Scheduler:
         self.unpin_adapter(req)
         self.allocator.free(req.blocks)
         req.blocks = []
+        req.cached_blocks = req.cached_tokens = 0
         req.slot = None
         req.state = "queued"
         req.out_tokens = []
@@ -365,23 +421,67 @@ class Scheduler:
         self._requeue_fifo(req)
         return req
 
+    def match_prefix(self, req: Request) -> tuple[list[int], int]:
+        """The request's longest reusable prompt prefix in the radix
+        index, capped so at least one prompt token is recomputed (the
+        final chunk must produce first-token logits) and floored to
+        ``match_align`` tokens."""
+        if self.prefix_cache is None:
+            return [], 0
+        if req._prefix_keys is None:
+            from .prefix_cache import block_hashes
+
+            req._prefix_keys = block_hashes(req.prompt, self.block_size)
+        cap = ((req.n_prompt - 1) // self.match_align) * self.match_align
+        return self.prefix_cache.match(req.prompt, max_tokens=cap,
+                                       keys=req._prefix_keys)
+
     def admit(self) -> list[tuple[int, Request]]:
         """Move queued requests into free slots (FIFO) while the fit
         check passes; returns the (slot, request) pairs admitted this
-        step — the engine prefills exactly these."""
+        step — the engine prefills exactly these.
+
+        Under prefix caching each admitted request refs its matched
+        blocks (shared, already resident) and allocates only the
+        uncached remainder; the plan may count on index eviction, so a
+        shortfall mid-loop reclaims cold leaves before granting.
+        """
         free_slots = [s for s in range(self.n_slots)
                       if self.slots[s] is None]
+        if not free_slots or not self.queue:
+            return []
+        pc = self.prefix_cache
         n_admit = admission_plan(
-            [(r.n_prompt, r.max_new_tokens) for r in self.queue],
+            [(r.n_prompt, r.max_new_tokens, self.match_prefix(r)[1])
+             for r in self.queue],
             len(free_slots), self.allocator.n_free,
             block_size=self.block_size, admission=self.admission,
-            spec_lookahead=self.spec_lookahead)
+            spec_lookahead=self.spec_lookahead,
+            n_evictable=(pc.n_evictable() if pc is not None else 0))
         admitted: list[tuple[int, Request]] = []
         for slot in free_slots[:n_admit]:
             req = self.queue.popleft()
-            got = self.allocator.alloc(self._blocks_at_admission(req))
-            assert got is not None, "admission_plan overshot the pool"
-            req.blocks = got
+            matched, n_cached = self.match_prefix(req)
+            # ref matched blocks FIRST: they must not be reclaimed by
+            # the eviction pass that makes room for the fresh remainder
+            for b in matched:
+                self.allocator.ref(b)
+            need = self._blocks_at_admission(req) - len(matched)
+            short = need - self.allocator.n_free
+            if short > 0 and pc is not None:
+                pc.evict(short)
+            got = self.allocator.acquire(need)
+            if got is None:
+                # an eviction shrank a later match the plan counted on;
+                # undo and keep strict FIFO (retry next step)
+                self.allocator.release(matched)
+                self.queue.appendleft(req)
+                break
+            if pc is not None:
+                pc.record_query(n_cached)
+            req.blocks = matched + got
+            req.cached_blocks = len(matched)
+            req.cached_tokens = n_cached
             req.slot = slot
             req.state = "running"
             req.out_tokens = []
@@ -422,6 +522,7 @@ class Scheduler:
         self.unpin_adapter(req)
         self.allocator.free(req.blocks)
         req.blocks = []
+        req.cached_blocks = req.cached_tokens = 0
         req.slot = None
         req.state = "done"
         req.t_done = self.clock()
@@ -443,6 +544,7 @@ class Scheduler:
         self.unpin_adapter(victim)
         self.allocator.free(victim.blocks)
         victim.blocks = []
+        victim.cached_blocks = victim.cached_tokens = 0
         victim.slot = None
         victim.state = "queued"
         victim.out_tokens = []
@@ -476,6 +578,12 @@ class Scheduler:
                         spec_lookahead=self.spec_lookahead):
                     break  # every write fits in owned blocks
                 got = self.allocator.alloc(1)
+                if got is None and self.prefix_cache is not None:
+                    # drop cold reusable KV before touching live work:
+                    # an unreferenced radix leaf is strictly cheaper to
+                    # reclaim than a preempt-and-recompute
+                    if self.prefix_cache.evict(1):
+                        got = self.allocator.alloc(1)
                 if got is not None:
                     req.blocks.extend(got)
                     continue  # lookahead may span a second block

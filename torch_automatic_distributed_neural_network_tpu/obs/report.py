@@ -163,6 +163,12 @@ def generate(target: str, metrics_path: str | None = None) -> dict:
                            if e["name"] == "export.error"]),
             "prewarms": len([e for e in exports
                              if e["name"] == "export.prewarm"]),
+            "gc_dropped": sum(
+                int(e.get("dropped") or 0) for e in exports
+                if e["name"] == "export.gc") or None,
+            "gc_payload_bytes_freed": sum(
+                int(e.get("payload_bytes_freed") or 0) for e in exports
+                if e["name"] == "export.gc") or None,
             "deserialize_total_s": deser or None,
             "mean_deserialize_s": _mean(e.get("deserialize_s")
                                         for e in hits),
@@ -407,6 +413,36 @@ def generate(target: str, metrics_path: str | None = None) -> dict:
             serving["mean_adapters_pinned"] = _mean(
                 e.get("adapters_pinned") for e in ssteps
                 if e.get("adapters_pinned") is not None)
+        sprefix = [e for e in events if e.get("name") == "serve.prefix"]
+        if sprefix or any(e.get("prefix_blocks") is not None
+                          for e in ssteps):
+            matches = [e for e in sprefix if e.get("kind") == "match"]
+            cached = int(sum(_finite(
+                e.get("cached_tokens") for e in matches)))
+            prompt_tokens = sum(_finite(
+                e.get("n_prompt") for e in sreqs))
+            serving["prefix_queries"] = len(matches)
+            serving["prefix_hit_requests"] = sum(
+                1 for e in matches if e.get("hit"))
+            serving["prefix_cached_tokens"] = cached
+            serving["prefix_hit_rate"] = (
+                cached / prompt_tokens if prompt_tokens else None)
+            chunk = serving.get("prefill_chunk")
+            # per-request floor, matching the engine: a cached span
+            # shorter than one chunk skips nothing
+            serving["prefix_saved_chunks"] = (
+                int(sum(int(t) // chunk for t in _finite(
+                    e.get("cached_tokens") for e in matches)))
+                if chunk else None)
+            serving["prefix_published_blocks"] = int(sum(_finite(
+                e.get("n_blocks") for e in sprefix
+                if e.get("kind") == "publish")))
+            serving["cow_forks"] = sum(
+                1 for e in sprefix if e.get("kind") == "cow")
+            resident = [e.get("prefix_blocks") for e in ssteps
+                        if e.get("prefix_blocks") is not None]
+            serving["prefix_blocks"] = (resident[-1] if resident
+                                        else None)
         report["serving"] = {k: v for k, v in serving.items()
                              if v is not None}
     lint_findings = [e for e in events if e.get("name") == "lint.finding"]
@@ -452,7 +488,9 @@ def generate(target: str, metrics_path: str | None = None) -> dict:
                       "quant_kv", "budget_bytes",
                       "block_bytes_per_device", "attention_impl",
                       "decode_workspace_bytes", "adapter_pool_bytes",
-                      "n_adapters", "adapter_rank", "quant_adapters")
+                      "n_adapters", "adapter_rank", "quant_adapters",
+                      "prefix_cache", "prefix_index_bytes",
+                      "expected_hit_rate", "effective_max_streams")
             if sest.get(k) is not None}
     ssweep = last("simulate.sweep")
     scands = [e for e in events if e.get("name") == "simulate.candidate"]
@@ -577,6 +615,11 @@ def format_report(report: dict) -> str:
                 f"{ex['compile_over_deserialize']}x compile/deserialize")
         if ex.get("prewarms"):
             parts.append(f"{ex['prewarms']} prewarm(s)")
+        if ex.get("gc_dropped"):
+            parts.append(
+                f"gc dropped {ex['gc_dropped']} "
+                f"({_fmt_bytes(ex.get('gc_payload_bytes_freed') or 0)} "
+                f"freed)")
         lines.append("  ".join(parts))
         if ex.get("stale"):
             reasons = ex.get("stale_reasons") or []
@@ -804,6 +847,24 @@ def format_report(report: dict) -> str:
                        if sv.get("mean_adapters_pinned") is not None
                        else ""))
             lines.append("  adapters: " + "  ".join(aparts))
+        if "prefix_queries" in sv or sv.get("prefix_blocks") is not None:
+            pparts = [
+                f"{sv.get('prefix_hit_requests', 0)}/"
+                f"{sv.get('prefix_queries', 0)} request(s) hit"]
+            if sv.get("prefix_hit_rate") is not None:
+                pparts.append(
+                    f"hit rate {sv['prefix_hit_rate']:.1%} "
+                    f"({sv.get('prefix_cached_tokens', 0)} cached "
+                    f"token(s))")
+            if sv.get("prefix_saved_chunks") is not None:
+                pparts.append(
+                    f"{sv['prefix_saved_chunks']} prefill chunk(s) "
+                    f"saved")
+            if sv.get("cow_forks"):
+                pparts.append(f"{sv['cow_forks']} CoW fork(s)")
+            if sv.get("prefix_blocks") is not None:
+                pparts.append(f"{sv['prefix_blocks']} block(s) indexed")
+            lines.append("  prefix cache: " + "  ".join(pparts))
     sest = report.get("serve_estimate")
     if sest:
         head = (f"serve estimate: {sest.get('max_streams')} stream(s) "
@@ -823,6 +884,14 @@ def format_report(report: dict) -> str:
                      f"r{sest.get('adapter_rank')} "
                      f"{'int8' if sest.get('quant_adapters') else 'f32'} "
                      f"({_fmt_bytes(sest.get('adapter_pool_bytes'))})")
+        if sest.get("prefix_cache"):
+            head += (f", prefix index "
+                     f"{_fmt_bytes(sest.get('prefix_index_bytes'))}")
+            if sest.get("effective_max_streams") is not None:
+                head += (f" (~{sest['effective_max_streams']} effective "
+                         f"stream(s) at "
+                         f"{sest.get('expected_hit_rate') or 0:.0%} hit "
+                         f"rate)")
         lines.append(head)
     sim = report.get("simulate")
     if sim:

@@ -36,6 +36,7 @@ import numpy as np
 from .. import planner
 from .. import topology as topo_mod
 from ..inference.serve.kv_pool import BlockAllocator, blocks_for_tokens
+from ..inference.serve.prefix_cache import PrefixCache
 from ..inference.serve.scheduler import Request, Scheduler
 from ..obs import journal as obs_journal
 from ..training.resilience import survival_probability
@@ -59,6 +60,13 @@ class TrafficMix:
     analytic tests rely on).  ``decode_mean`` is the EXPECTED tokens
     before EOS — the replay emits EOS there, so ``max_new`` is the
     budget, not the typical length, exactly like production traffic.
+
+    ``shared_prefix`` models prefix-heavy production traffic: every
+    request's prompt opens with that many IDENTICAL tokens (a system
+    prompt / few-shot preamble), the rest unique per request.  With
+    ``replay_serve(prefix_cache=True)`` the replay's radix index then
+    prices the redundant-prefill savings; with the cache off the knob
+    changes nothing (content never affects timing there).
     """
 
     rate_per_s: float = 16.0
@@ -68,6 +76,7 @@ class TrafficMix:
     decode_mean: int | None = None
     jitter: float = 0.5
     seed: int = 0
+    shared_prefix: int = 0
 
     @classmethod
     def parse(cls, text: str | None) -> "TrafficMix":
@@ -75,7 +84,8 @@ class TrafficMix:
         if not text or not text.strip():
             return cls()
         alias = {"rate": "rate_per_s", "n": "n_requests",
-                 "prompt": "prompt_mean", "decode": "decode_mean"}
+                 "prompt": "prompt_mean", "decode": "decode_mean",
+                 "shared": "shared_prefix"}
         fields = {f.name: f.type for f in dataclasses.fields(cls)}
         kwargs: dict[str, Any] = {}
         for clause in text.split(","):
@@ -139,6 +149,8 @@ def replay_serve(
     disaggregate: bool = False,
     kv_ship_s: float = 0.0,
     dcn_step_s: float = 0.0,
+    prefix_cache: bool = False,
+    shared_prefix: int = 0,
     max_steps: int = 200_000,
 ) -> dict:
     """Discrete-event replay of the serving scheduler on virtual time.
@@ -162,15 +174,29 @@ def replay_serve(
     instead of their sum.  ``dcn_step_s`` prices per-decode-step
     cross-slice collectives (a tp group spanning slices); it is added
     on the decode side in both modes.
+
+    ``prefix_cache`` drives a REAL :class:`PrefixCache` (the engine's
+    radix index, same eviction and admission interplay): prompts are
+    synthesized as ``shared_prefix`` identical tokens plus a unique
+    per-request suffix, each finished prefill publishes its full
+    prompt blocks, and a later request's matched prefix skips those
+    chunks — so the replay PRICES the hit rate instead of assuming one.
     """
+    if prefix_cache and not prefill_chunk:
+        raise ValueError(
+            "prefix_cache=True requires chunked prefill (the replay "
+            "mirrors the engine's contract)")
     clock = [0.0]
     if num_blocks is None:
         num_blocks = n_slots * blocks_for_tokens(max_len, block_size) + 1
     alloc = BlockAllocator(num_blocks)
+    pc = (PrefixCache(block_size=block_size, allocator=alloc,
+                      clock=lambda: clock[0])
+          if prefix_cache else None)
     sched = Scheduler(
         n_slots=n_slots, allocator=alloc, block_size=block_size,
         admission=admission, spec_lookahead=spec_lookahead,
-        clock=lambda: clock[0])
+        prefix_cache=pc, clock=lambda: clock[0])
     chunk = (math.gcd(min(int(prefill_chunk), max_len), max_len)
              if prefill_chunk else None)
 
@@ -197,7 +223,13 @@ def replay_serve(
         while (next_arrival < len(pending)
                and pending[next_arrival][0] <= clock[0] + 1e-12):
             arr, n_prompt, max_new, n_dec = pending[next_arrival]
-            req = Request(prompt=[1] * int(n_prompt),
+            # shared-prefix content: the radix index matches on token
+            # ids, so the shared head must be identical and the tail
+            # unique per request (cache off: content is timing-inert)
+            n_shared = max(0, min(int(shared_prefix), int(n_prompt) - 1))
+            prompt = ([1] * n_shared
+                      + [2 + next_arrival] * (int(n_prompt) - n_shared))
+            req = Request(prompt=prompt,
                           max_new_tokens=int(max_new), eos_id=0)
             req.t_submit = float(arr)
             n_decode_of[req.rid] = int(n_dec)
@@ -237,7 +269,9 @@ def replay_serve(
                     done.append(sched.evict(slot))
             else:
                 req.state = "prefilling"
-                prefill_pos[req.rid] = 0
+                # a prefix-cache hit starts the cursor after the
+                # matched blocks — the skipped chunks are the savings
+                prefill_pos[req.rid] = req.cached_tokens
         budget = None if disaggregate else prefill_chunks_per_step
         for slot, req in sched.prefill_plan(budget):
             pos = prefill_pos[req.rid]
@@ -248,6 +282,12 @@ def replay_serve(
             if pos >= req.n_prompt:
                 del prefill_pos[req.rid]
                 step_pf_s += ship(slot, req)
+                if pc is not None:
+                    # publish full prompt blocks (engine: at commit /
+                    # KV-ship time)
+                    n_pub = req.n_prompt // block_size
+                    pc.insert(req.prompt[:n_pub * block_size],
+                              req.blocks[:n_pub])
                 emit(req)
                 req.t_first_token = clock[0]
                 req.state = "running"
@@ -301,6 +341,15 @@ def replay_serve(
         "p99_s": float(np.percentile(totals, 99)) if totals else None,
         "p99_admission_wait_s": (float(np.percentile(waits, 99))
                                  if waits else None),
+        "prefix_cache": bool(prefix_cache),
+        **({"prefix_queries": pc.queries,
+            "prefix_hit_requests": pc.hit_requests,
+            "prefix_hit_tokens": pc.hit_tokens,
+            "prefix_hit_rate": (
+                pc.hit_tokens
+                / max(1, sum(int(r[1]) for r in requests))),
+            "prefix_evicted_blocks": pc.evicted_blocks}
+           if pc is not None else {}),
     }
 
 
@@ -339,6 +388,10 @@ def replay_bench_record(extra: Mapping[str, Any]) -> dict:
         # r04+ records carry the engine mode; the in-process bench ships
         # blocks at HBM speed, so no extra kv_ship_s term here
         disaggregate=bool(extra.get("disaggregate")),
+        # r05+ records carry the prefix-cache mix; the replay reprices
+        # the recorded hit rate instead of trusting it
+        prefix_cache=bool(extra.get("prefix_cache")),
+        shared_prefix=int(extra.get("shared_prefix") or 0),
     )
     obs_journal.event("simulate.replay", source="bench_record", **{
         k: result[k] for k in ("steps", "new_tokens", "tokens_per_s",
@@ -378,6 +431,9 @@ class SimulatePolicy:
     # DCN on multislice fleets, step wall = max(prefill, decode)
     disaggregate: bool = False
     quant_kv: bool = False
+    # cross-request prefix caching (engine --prefix-cache): the replay
+    # drives the real radix index over TrafficMix.shared_prefix traffic
+    prefix_cache: bool = False
     adapters: int = 0
     adapter_rank: int = 8
     # measured per-step costs override the analytic serving-time model
@@ -528,6 +584,11 @@ def simulate(
                         params_bytes=params_bytes // max(1, tensor),
                         adapters=policy.adapters or None,
                         adapter_rank=policy.adapter_rank,
+                        prefix_cache=policy.prefix_cache,
+                        expected_hit_rate=(
+                            min(0.95, traffic.shared_prefix
+                                / max(1, traffic.prompt_mean))
+                            if policy.prefix_cache else 0.0),
                         degrees={"tensor": tensor})
                 serve_est = serve_memo[sk]
 
@@ -603,7 +664,7 @@ def simulate(
                                       + chip.dcn_latency_s)
                     rk = (adm, slots, serve_est["num_blocks"],
                           round(dec_s, 9), round(pf_s, 9),
-                          policy.disaggregate,
+                          policy.disaggregate, policy.prefix_cache,
                           round(ship_s, 9), round(dcn_s, 9))
                     if rk not in replay_memo:
                         replay_memo[rk] = replay_serve(
@@ -616,7 +677,9 @@ def simulate(
                             spec_lookahead=policy.spec_lookahead,
                             decode_step_s=dec_s, prefill_chunk_s=pf_s,
                             disaggregate=policy.disaggregate,
-                            kv_ship_s=ship_s, dcn_step_s=dcn_s)
+                            kv_ship_s=ship_s, dcn_step_s=dcn_s,
+                            prefix_cache=policy.prefix_cache,
+                            shared_prefix=traffic.shared_prefix)
                         obs_journal.event(
                             "simulate.replay", admission=adm,
                             slots=slots, decode_step_ms=dec_s * 1e3,
