@@ -321,6 +321,14 @@ def run_load(args, journal) -> dict:
 
     totals = sorted((r.t_done or 0.0) - r.t_submit for r in done)
     new_tokens = sum(r.n_generated for r in done)
+    # latency percentiles from the r06 request timelines: TTFT =
+    # submit -> first sampled token, ITL = consecutive token-stamp
+    # diffs (a speculative burst contributes zeros — tokens that
+    # arrived together)
+    ttfts = sorted(r.t_first_token - r.t_submit for r in done
+                   if r.t_first_token is not None)
+    itls = sorted(b - a for r in done
+                  for a, b in zip(r.token_walls, r.token_walls[1:]))
 
     # per-step breakdown: journal means for the TIMED window's steps
     # (warm-phase records sliced off — they carry the compiles) plus a
@@ -386,6 +394,12 @@ def run_load(args, journal) -> dict:
             "wall_s": round(wall, 4),
             "p50_ms": round(_pct(totals, 0.50) * 1e3, 2),
             "p99_ms": round(_pct(totals, 0.99) * 1e3, 2),
+            "ttft_ms": ({"p50": round(_pct(ttfts, 0.50) * 1e3, 2),
+                         "p99": round(_pct(ttfts, 0.99) * 1e3, 2)}
+                        if ttfts else None),
+            "itl_ms": ({"p50": round(_pct(itls, 0.50) * 1e3, 3),
+                        "p99": round(_pct(itls, 0.99) * 1e3, 3)}
+                       if itls else None),
             "mean_occupancy": (round(eng.mean_occupancy, 4)
                                if eng.mean_occupancy is not None
                                else None),

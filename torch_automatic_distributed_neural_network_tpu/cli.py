@@ -592,6 +592,68 @@ def cmd_report(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_monitor(args: argparse.Namespace) -> int:
+    """Continuous SLO monitor over a serving journal (obs/slo_monitor):
+    fold ``serve.*`` events into rolling event-time windows, evaluate
+    the ``--slo`` spec per window with hysteresis, journal
+    ``slo.breach`` / ``slo.recover`` incidents, and optionally compare
+    measured throughput against the simulate replay's prediction for a
+    committed bench record (``--drift``).  ``--follow`` tails a live
+    journal; the default deterministically replays a finished one —
+    with ``--check`` the exit code is the CI gate (nonzero on any
+    breach or out-of-band planner drift).  Pure file parsing unless
+    ``--drift`` is given — no accelerator needed."""
+    from .obs import slo_monitor as slm
+    from .obs.journal import Journal
+    from .tune.slo import SLOSpec
+
+    if args.follow and args.replay:
+        print("monitor: --follow and --replay are mutually exclusive",
+              file=sys.stderr)
+        return 2
+    try:
+        spec = SLOSpec.parse(args.slo)
+    except ValueError as e:
+        print(f"monitor: {e}", file=sys.stderr)
+        return 2
+    if not os.path.isfile(args.journal):
+        print(f"monitor: no journal at {args.journal}", file=sys.stderr)
+        return 2
+    policy = slm.MonitorPolicy(
+        slo=spec, window_s=args.window,
+        breach_after=args.breach_after,
+        recover_after=args.recover_after,
+        n_chips=args.chips, warmup_windows=args.warmup_windows)
+    drift_extra = None
+    if args.drift:
+        with open(args.drift) as f:
+            rec = json.load(f)
+        # a full bench record or a bare extra dict both work
+        drift_extra = rec.get("extra") or rec
+    # incidents land in their own sink: --replay must never append to
+    # the (possibly committed) journal it is reading
+    with Journal(args.incident_journal, host0_only=False,
+                 meta={"tool": "monitor",
+                       "source": args.journal}) as sink:
+        records = (Journal.follow(args.journal,
+                                  idle_timeout=args.idle_timeout)
+                   if args.follow else Journal.read(args.journal))
+        summary = slm.monitor_records(
+            records, policy, journal=sink, drift_extra=drift_extra)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(summary, f)
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print(slm.format_summary(summary))
+    if args.check:
+        drift_bad = ((summary.get("drift") or {}).get("within_band")
+                     is False)
+        return 1 if (summary["breaches"] or drift_bad) else 0
+    return 0
+
+
 def cmd_doctor(args: argparse.Namespace) -> int:
     """Verify a checkpoint directory's integrity and print the fallback
     chain restore_or_init would walk.  Exit 0 when at least one step is
@@ -1532,6 +1594,61 @@ def main(argv: list[str] | None = None) -> int:
                         "through the what-if serve replay and fail when "
                         "prediction and measurement disagree by >2x")
     p.set_defaults(fn=cmd_report)
+
+    p = sub.add_parser(
+        "monitor",
+        help="continuous SLO monitor over a serving journal: rolling "
+             "TTFT/ITL/latency windows, slo.breach/slo.recover "
+             "incidents with hysteresis, planner drift vs the serve "
+             "replay (works offline; no accelerator needed)",
+    )
+    p.add_argument("journal", help="serving journal JSONL to monitor")
+    p.add_argument("--slo", default=None,
+                   help='spec over window aggregates, e.g. '
+                        '"p99_ms<=2500,ttft_ms<=2000,itl_ms<=100" '
+                        "(tune/slo fields; empty = report only)")
+    p.add_argument("--window", type=float, default=5.0,
+                   help="window width in event-time seconds")
+    p.add_argument("--replay", action="store_true",
+                   help="deterministically replay the journal from the "
+                        "start (the default mode, spelled out)")
+    p.add_argument("--follow", action="store_true",
+                   help="tail a concurrently-appending journal instead "
+                        "of replaying a finished one")
+    p.add_argument("--idle-timeout", type=float, default=30.0,
+                   dest="idle_timeout",
+                   help="--follow: stop after this many seconds with "
+                        "no new records")
+    p.add_argument("--breach-after", type=int, default=2,
+                   dest="breach_after",
+                   help="consecutive violating windows before a breach "
+                        "incident (hysteresis)")
+    p.add_argument("--recover-after", type=int, default=2,
+                   dest="recover_after",
+                   help="consecutive clean windows before recovery")
+    p.add_argument("--warmup-windows", type=int, default=1,
+                   dest="warmup_windows",
+                   help="leading traffic windows reported but not "
+                        "SLO-evaluated (they carry the jit compiles; "
+                        "same discipline as bench_serve's warm phase)")
+    p.add_argument("--chips", type=int, default=1,
+                   help="chip count for tok_s_chip evaluation")
+    p.add_argument("--drift", default=None,
+                   help="SERVE_BENCH_r*.json record: compare measured "
+                        "throughput against the simulate replay's "
+                        "prediction and flag >2x planner drift")
+    p.add_argument("--incident-journal", default=None,
+                   dest="incident_journal",
+                   help="append slo.breach/slo.recover/simulate.drift "
+                        "events to this JSONL (renderable by tadnn "
+                        "report)")
+    p.add_argument("--out", default=None,
+                   help="write the full monitor summary JSON here")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--check", action="store_true",
+                   help="exit nonzero on any breach or out-of-band "
+                        "drift — the CI gate")
+    p.set_defaults(fn=cmd_monitor)
 
     p = sub.add_parser(
         "serve",

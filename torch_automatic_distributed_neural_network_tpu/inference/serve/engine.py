@@ -62,12 +62,19 @@ needs no cleanup: positions past a slot's context are masked out of
 attention and overwritten by the next step's writes.  Accept rates
 journal as ``serve.speculate`` events.
 
-Telemetry: every finished request journals a ``serve.request`` event
-(queue/prefill/decode/total seconds, tokens/s, preemption count) and
-every step a ``serve.step`` event (slot occupancy, free blocks,
-adapter residency) through ``obs.journal`` — ``tadnn report`` renders
-p50/p99 latency, goodput, occupancy, and speculative accept rates from
-exactly these records.
+Telemetry: every finished request journals a ``serve.request_done``
+event carrying its full span timeline — submit -> admit (queue wait)
+-> prefill chunks (prefix-cache skip included) -> KV ship
+(disaggregated) -> first token (TTFT) -> per-token inter-token
+latencies -> preempt/recompute tax -> finish — and every step a
+``serve.step`` event (slot occupancy, free blocks, tokens emitted,
+adapter residency) through ``obs.journal``.  ``tadnn report`` renders
+p50/p99 latency, TTFT/ITL percentiles, goodput, occupancy, and
+speculative accept rates from exactly these records, and ``tadnn
+monitor`` (obs/slo_monitor) folds the same stream into rolling SLO
+windows while the engine is still running.  Timeline stamps route
+through the scheduler's injectable clock so a discrete-event replay
+produces the same fields on virtual time.
 """
 
 from __future__ import annotations
@@ -491,6 +498,10 @@ class ServeEngine:
         self.overlapped_wall_s = 0.0
         self.spec_drafted = 0   # lifetime draft-token counters (k > 0)
         self.spec_accepted = 0
+        # lifetime generated-token count; step() diffs it to put a
+        # per-step new_tokens field on serve.step (the live monitor's
+        # smooth tok/s signal — request completions are too lumpy)
+        self.tokens_emitted = 0
         self.finished: list[Request] = []
         self._prefill: dict[int, _PrefillState] = {}
         self._step_fn = jax.jit(
@@ -746,7 +757,9 @@ class ServeEngine:
             _sample(logits, first_rng, self.sample))[0])
         self._commit_prefill(slot, req, cache.k[:, 0], cache.v[:, 0])
         req.out_tokens = [first]
-        req.t_first_token = time.monotonic()
+        req.t_first_token = self.scheduler.clock()
+        req.token_walls = [req.t_first_token]
+        self.tokens_emitted += 1
 
     def _start_prefill(self, slot: int, req: Request) -> None:
         """Admission entry point: legacy single-shot prefill, or flip
@@ -840,14 +853,19 @@ class ServeEngine:
                                     req.cached_tokens:req.n_prompt]
             self._commit_prefill(slot, req, k_rows, v_rows)
             req.out_tokens = [first]
-            req.t_first_token = time.monotonic()
+            req.t_first_token = self.scheduler.clock()
+            req.token_walls = [req.t_first_token]
+            self.tokens_emitted += 1
             req.state = "running"
             del self._prefill[req.rid]
+        chunk_s = time.monotonic() - t0
+        req.prefill_chunks += 1
+        req.prefill_compute_s += chunk_s
         if self.journal is not None:
             self.journal.event(
                 "serve.prefill_chunk", rid=req.rid, slot=slot,
                 pos=min(st.pos, req.n_prompt), n_tokens=n_real,
-                seconds=time.monotonic() - t0,
+                seconds=chunk_s,
                 done=bool(done and not bounced))
 
     def _cow_fork_writes(self) -> None:
@@ -923,10 +941,15 @@ class ServeEngine:
             jnp.asarray(ctx), jnp.asarray(tok), jnp.asarray(act),
             factors, jnp.asarray(ids), step_rng)
         out = np.asarray(jax.device_get(out))
+        # one stamp per step: every token this step emits shares it (a
+        # speculative burst lands together, so its interior ITLs are 0)
+        now = self.scheduler.clock()
         if not k_spec:
             for s, req in enumerate(self.scheduler.slots):
                 if req is not None and req.state == "running":
                     req.out_tokens.append(int(out[s]))
+                    req.token_walls.append(now)
+                    self.tokens_emitted += 1
             return
         drafted = accepted = n_active = 0
         for s, req in enumerate(self.scheduler.slots):
@@ -947,6 +970,8 @@ class ServeEngine:
             if req.eos_id is not None and req.eos_id in emit:
                 emit = emit[:emit.index(req.eos_id) + 1]
             req.out_tokens.extend(emit)
+            req.token_walls.extend([now] * len(emit))
+            self.tokens_emitted += len(emit)
         self.spec_drafted += drafted
         self.spec_accepted += accepted
         if self.journal is not None:
@@ -956,23 +981,43 @@ class ServeEngine:
                 accept_rate=(accepted / drafted if drafted else None))
 
     def _finish(self, slot: int) -> None:
+        # evict() zeroes the prefix-cache accounting with the block
+        # table; read it while the request still owns its slot
+        cached_tokens = self.scheduler.slots[slot].cached_tokens
         req = self.scheduler.evict(slot)
         self.finished.append(req)
         if self.journal is None:
             return
+        # phase attribution: queue_s runs submit -> LAST admission (so
+        # it absorbs time spent queued again after a preemption; lost_s
+        # separates out the thrown-away attempts), prefill_s runs
+        # admission -> first token, decode_s first token -> done
         queue_s = (req.t_admit or req.t_submit) - req.t_submit
         prefill_s = ((req.t_first_token - req.t_admit)
                      if req.t_first_token and req.t_admit else None)
         decode_s = ((req.t_done - req.t_first_token)
                     if req.t_first_token else None)
         total_s = req.t_done - req.t_submit
+        walls = req.token_walls
+        itl_s = [round(b - a, 6) for a, b in zip(walls, walls[1:])]
         self.journal.event(
-            "serve.request", rid=req.rid, n_prompt=req.n_prompt,
+            "serve.request_done", rid=req.rid, n_prompt=req.n_prompt,
             n_new=req.n_generated, queue_s=queue_s,
             prefill_s=prefill_s, decode_s=decode_s, total_s=total_s,
             tokens_per_s=(req.n_generated / decode_s
                           if decode_s else None),
-            preempted=req.preempted)
+            preempted=req.preempted,
+            ttft_s=((req.t_first_token - req.t_submit)
+                    if req.t_first_token else None),
+            itl_s=itl_s,
+            itl_mean_s=(sum(itl_s) / len(itl_s) if itl_s else None),
+            kv_ship_s=((req.t_kv_shipped - req.t_admit)
+                       if req.t_kv_shipped and req.t_admit else None),
+            cached_tokens=cached_tokens or None,
+            prefill_chunks=req.prefill_chunks or None,
+            prefill_compute_s=(round(req.prefill_compute_s, 6)
+                               if req.prefill_chunks else None),
+            lost_s=req.lost_s or None)
 
     def step(self) -> None:
         """One serving iteration: evict finished, admit queued, advance
@@ -985,6 +1030,7 @@ class ServeEngine:
         wall time is ``max(prefill, decode)`` — the slices run
         concurrently, only the KV-block shipment couples them."""
         sched = self.scheduler
+        tokens_before = self.tokens_emitted
         for s in range(self.n_slots):
             req = sched.slots[s]
             if (req is not None and req.state == "running"
@@ -1038,6 +1084,7 @@ class ServeEngine:
                 "serve.step", step=self._step_count,
                 n_active=sched.n_active, n_queued=sched.n_queued,
                 n_prefilling=sched.n_prefilling,
+                new_tokens=self.tokens_emitted - tokens_before,
                 occupancy=sched.n_active / self.n_slots,
                 free_blocks=self.pool.allocator.n_free,
                 prefill_s=prefill_s, decode_s=decode_s,

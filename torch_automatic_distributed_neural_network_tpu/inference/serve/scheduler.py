@@ -196,7 +196,7 @@ class Request:
     _prefix_keys: list | None = dataclasses.field(
         default=None, repr=False, compare=False)
 
-    # wall-clock marks for the serve.request span fields
+    # wall-clock marks for the serve.request_done span fields
     t_submit: float = dataclasses.field(default_factory=time.monotonic)
     t_admit: float | None = None
     t_first_token: float | None = None
@@ -204,6 +204,18 @@ class Request:
     # shipped from the prefill slice into the decode slice's pool
     t_kv_shipped: float | None = None
     t_done: float | None = None
+    # per-token emission stamps (scheduler clock): consecutive diffs
+    # are the inter-token latencies; cleared with out_tokens on
+    # preemption — only the surviving attempt's stream is reported
+    token_walls: list[float] = dataclasses.field(
+        default_factory=list, repr=False, compare=False)
+    # chunked-prefill accounting, cumulative across attempts (preempted
+    # work was still computed — it belongs in the phase attribution)
+    prefill_chunks: int = 0
+    prefill_compute_s: float = 0.0
+    # wall time spent in attempts that were later thrown away
+    # (admit -> preempt/requeue): the recompute tax, per request
+    lost_s: float = 0.0
 
     @property
     def n_prompt(self) -> int:
@@ -415,6 +427,9 @@ class Scheduler:
         req.slot = None
         req.state = "queued"
         req.out_tokens = []
+        req.token_walls = []
+        if req.t_admit is not None:
+            req.lost_s += max(0.0, self.clock() - req.t_admit)
         req.preempted += 1
         self.n_preemptions += 1
         self.slots[slot] = None
@@ -548,6 +563,9 @@ class Scheduler:
         victim.slot = None
         victim.state = "queued"
         victim.out_tokens = []
+        victim.token_walls = []
+        if victim.t_admit is not None:
+            victim.lost_s += max(0.0, self.clock() - victim.t_admit)
         victim.preempted += 1
         self.n_preemptions += 1
         self.slots[slot] = None

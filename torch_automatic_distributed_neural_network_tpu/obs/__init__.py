@@ -7,7 +7,7 @@ a process-global journal (``set_default`` / ``TADNN_JOURNAL`` env); when
 none is installed every call is a cheap no-op.
 """
 
-from . import aggregate, trace
+from . import aggregate, live, slo_monitor, trace
 from .goodput import BUCKETS, GoodputMeter
 from .journal import (
     Journal,
@@ -17,16 +17,24 @@ from .journal import (
     set_default,
     span,
 )
+from .live import LatencySketch, LiveAggregator
+from .slo_monitor import MonitorPolicy, SLOMonitor
 
 __all__ = [
     "BUCKETS",
     "GoodputMeter",
     "Journal",
+    "LatencySketch",
+    "LiveAggregator",
+    "MonitorPolicy",
+    "SLOMonitor",
     "aggregate",
     "as_default",
     "event",
     "get_default",
     "set_default",
     "span",
+    "live",
+    "slo_monitor",
     "trace",
 ]

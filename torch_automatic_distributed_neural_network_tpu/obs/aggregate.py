@@ -26,13 +26,16 @@ _HOST_IN_NAME = re.compile(r"(?:host|proc|p)[._-]?(\d+)")
 
 
 def find_host_journals(directory: str) -> list[str]:
-    """Per-host journal files in a run directory: every ``*.jsonl`` whose
-    name contains 'journal', sorted (merged outputs excluded so a
-    re-merge is idempotent)."""
+    """Per-host journal files in a run directory: every ``*.jsonl``
+    whose name contains 'journal' or 'serve' (serving engines journal
+    per-process too — ``serve.host0.jsonl`` merges like a training
+    journal), sorted; merged outputs excluded so a re-merge is
+    idempotent."""
     out = [
         os.path.join(directory, f)
         for f in sorted(os.listdir(directory))
-        if f.endswith(".jsonl") and "journal" in f and "merged" not in f
+        if f.endswith(".jsonl") and "merged" not in f
+        and ("journal" in f or "serve" in f)
     ]
     return out
 
@@ -59,6 +62,11 @@ def merge(journals: "Sequence[str] | Mapping[int, str]") -> list[dict]:
 
     ``journals`` is a list of paths (host ids inferred) or an explicit
     ``{host_id: path}`` mapping.
+
+    Records pass through untouched apart from the ``host`` tag —
+    serving telemetry (``serve.*``, ``slo.*``, ``simulate.drift``)
+    keeps every field, so ``tadnn report`` and ``tadnn monitor`` read
+    a merged multihost serving journal exactly like a single-host one.
     """
     if isinstance(journals, Mapping):
         items = [(int(h), p) for h, p in sorted(journals.items())]

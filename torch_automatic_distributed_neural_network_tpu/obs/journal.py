@@ -221,6 +221,57 @@ class Journal:
         return out
 
 
+    @staticmethod
+    def follow(path: str, *, poll_s: float = 0.2,
+               idle_timeout: float | None = None,
+               stop=None, sleep=time.sleep) -> Iterator[dict]:
+        """Tail a journal file as a concurrent writer appends to it.
+
+        Yields each record as soon as its line is complete.  A torn
+        final line — the writer seen mid-record — is buffered until its
+        newline arrives, so a live reader never drops the record a
+        crash-time reader would have skipped; interior corrupt lines
+        are skipped with the same once-per-file warning as ``read``.
+
+        Stops when ``stop()`` returns true (checked between polls) or
+        after ``idle_timeout`` seconds with no new bytes (None = follow
+        forever).  ``sleep`` is injectable so tests can drive the tail
+        loop without real waiting.
+        """
+        buf = ""
+        idle = 0.0
+        with open(path) as f:
+            while True:
+                chunk = f.read()
+                if chunk:
+                    idle = 0.0
+                    buf += chunk
+                    while "\n" in buf:
+                        line, _, buf = buf.partition("\n")
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            rec = json.loads(line)
+                        except ValueError:
+                            rec = None
+                        if isinstance(rec, dict):
+                            yield rec
+                            continue
+                        if path not in _warned_corrupt:
+                            _warned_corrupt.add(path)
+                            warnings.warn(
+                                f"journal {path}: skipping torn/corrupt "
+                                f"line(s) while following", stacklevel=2)
+                    continue
+                if stop is not None and stop():
+                    return
+                if idle_timeout is not None and idle >= idle_timeout:
+                    return
+                sleep(poll_s)
+                idle += poll_s
+
+
 # paths already warned about corrupt lines (once-per-file, process-wide)
 _warned_corrupt: set[str] = set()
 

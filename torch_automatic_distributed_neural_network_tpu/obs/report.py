@@ -326,7 +326,10 @@ def generate(target: str, metrics_path: str | None = None) -> dict:
                                       if durs else None),
             }
         report["launch"] = launch
-    sreqs = [e for e in events if e.get("name") == "serve.request"]
+    # r06 renamed serve.request -> serve.request_done (full span
+    # timeline); older committed journals still render
+    sreqs = [e for e in events
+             if e.get("name") in ("serve.request", "serve.request_done")]
     ssteps = [e for e in events if e.get("name") == "serve.step"]
     spreempt = [e for e in events if e.get("name") == "serve.preempt"]
     sengine = last("serve.engine")
@@ -377,6 +380,27 @@ def generate(target: str, metrics_path: str | None = None) -> dict:
             "overlapped_wall_s": (sum(_finite(
                 e.get("overlap_s") for e in ssteps)) or None),
         }
+        # request span timelines (r06 serve.request_done fields): TTFT
+        # and inter-token latency percentiles plus the mean phase mix —
+        # where a request's wall time went, attributed per phase
+        ttfts = sorted(_finite(e.get("ttft_s") for e in sreqs))
+        itls = sorted(_finite(
+            v for e in sreqs for v in (e.get("itl_s") or ())))
+        if ttfts:
+            serving["ttft_p50_s"] = pct(ttfts, 0.50)
+            serving["ttft_p99_s"] = pct(ttfts, 0.99)
+        if itls:
+            serving["itl_p50_s"] = pct(itls, 0.50)
+            serving["itl_p99_s"] = pct(itls, 0.99)
+        phase_means = {
+            label: _mean(e.get(key) for e in sreqs)
+            for label, key in (("queue", "queue_s"),
+                               ("prefill", "prefill_s"),
+                               ("decode", "decode_s"),
+                               ("lost", "lost_s"))}
+        if any(v is not None for v in phase_means.values()):
+            serving["phase_mean_s"] = {
+                k: v for k, v in phase_means.items() if v is not None}
         ships = [e for e in events if e.get("name") == "serve.kv_ship"]
         if ships:
             serving["kv_ships"] = len(ships)
@@ -445,6 +469,36 @@ def generate(target: str, metrics_path: str | None = None) -> dict:
                                         else None)
         report["serving"] = {k: v for k, v in serving.items()
                              if v is not None}
+    # SLO incidents (obs/slo_monitor): breach/recover transitions the
+    # monitor journaled while watching (or replaying) this run
+    breaches = [e for e in events if e.get("name") == "slo.breach"]
+    recovers = [e for e in events if e.get("name") == "slo.recover"]
+    if breaches or recovers:
+        report["slo_incidents"] = {
+            "breaches": len(breaches),
+            "recoveries": len(recovers),
+            "incidents": sorted(
+                ([{"kind": "breach",
+                   "window_start_s": e.get("window_start_s"),
+                   "window_end_s": e.get("window_end_s"),
+                   "violations": e.get("violations") or []}
+                  for e in breaches]
+                 + [{"kind": "recover",
+                     "window_start_s": e.get("window_start_s"),
+                     "window_end_s": e.get("window_end_s"),
+                     "ok_windows": e.get("ok_windows")}
+                    for e in recovers]),
+                key=lambda i: (i.get("window_start_s") or 0.0)),
+        }
+    # planner drift (obs/slo_monitor.drift_check): measured throughput
+    # left the simulate prediction's 2x band
+    drifts = [e for e in events if e.get("name") == "simulate.drift"]
+    if drifts:
+        report["drift"] = [
+            {"predicted_tok_s": e.get("predicted_tok_s"),
+             "measured_tok_s": e.get("measured_tok_s"),
+             "ratio": e.get("ratio"), "band": e.get("band")}
+            for e in drifts]
     lint_findings = [e for e in events if e.get("name") == "lint.finding"]
     lint_summary = last("lint.summary")
     lint_skipped = last("lint.skipped")
@@ -784,6 +838,17 @@ def format_report(report: dict) -> str:
             head += f", goodput {sv['goodput_tokens_per_s']:.1f} tok/s"
         lines.append(head)
         parts = []
+        if sv.get("ttft_p50_s") is not None:
+            tl = (f"  timeline: ttft p50 {sv['ttft_p50_s'] * 1e3:.1f}ms"
+                  f" p99 {sv.get('ttft_p99_s', 0) * 1e3:.1f}ms")
+            if sv.get("itl_p50_s") is not None:
+                tl += (f"  itl p50 {sv['itl_p50_s'] * 1e3:.2f}ms"
+                       f" p99 {sv.get('itl_p99_s', 0) * 1e3:.2f}ms")
+            pm = sv.get("phase_mean_s") or {}
+            if pm:
+                tl += ("  phase mix " + " ".join(
+                    f"{k} {v * 1e3:.0f}ms" for k, v in pm.items()))
+            lines.append(tl)
         if sv.get("mean_occupancy") is not None:
             parts.append(f"slot occupancy {sv['mean_occupancy']:.1%} "
                          f"over {sv.get('n_steps', 0)} step(s)")
@@ -865,6 +930,31 @@ def format_report(report: dict) -> str:
             if sv.get("prefix_blocks") is not None:
                 pparts.append(f"{sv['prefix_blocks']} block(s) indexed")
             lines.append("  prefix cache: " + "  ".join(pparts))
+    slo = report.get("slo_incidents")
+    if slo:
+        lines.append(f"slo incidents: {slo.get('breaches', 0)} "
+                     f"breach(es), {slo.get('recoveries', 0)} "
+                     f"recovery(ies)")
+        for inc in slo.get("incidents", ()):
+            where = (f"window [{inc.get('window_start_s')}s, "
+                     f"{inc.get('window_end_s')}s)")
+            if inc.get("kind") == "breach":
+                lines.append("  BREACH " + where + ": "
+                             + "; ".join(inc.get("violations") or ()))
+            else:
+                lines.append(
+                    "  recovered " + where
+                    + (f" after {inc['ok_windows']} clean window(s)"
+                       if inc.get("ok_windows") is not None else ""))
+    drift = report.get("drift")
+    if drift:
+        for d in drift:
+            lines.append(
+                f"planner drift: measured "
+                f"{(d.get('measured_tok_s') or 0):.1f} tok/s vs "
+                f"predicted {(d.get('predicted_tok_s') or 0):.1f} "
+                f"(x{(d.get('ratio') or 0):.2f}, outside "
+                f"{(d.get('band') or 0):g}x band)")
     sest = report.get("serve_estimate")
     if sest:
         head = (f"serve estimate: {sest.get('max_streams')} stream(s) "

@@ -212,6 +212,9 @@ def replay_serve(
         # engine uses
         eos_at = n_decode_of[req.rid]
         req.out_tokens.append(0 if req.n_generated + 1 >= eos_at else 1)
+        # virtual-time token stamp: consecutive diffs are the replay's
+        # predicted inter-token latencies, same field the engine fills
+        req.token_walls.append(clock[0])
 
     steps = 0
     occ_sum = 0.0
@@ -320,6 +323,10 @@ def replay_serve(
 
     totals = [r.t_done - r.t_submit for r in done if r.t_done is not None]
     waits = [r.t_admit - r.t_submit for r in done if r.t_admit is not None]
+    ttfts = [r.t_first_token - r.t_submit for r in done
+             if r.t_first_token is not None]
+    itls = [b - a for r in done
+            for a, b in zip(r.token_walls, r.token_walls[1:])]
     new_tokens = sum(r.n_generated for r in done)
     wall = clock[0]
     return {
@@ -341,6 +348,10 @@ def replay_serve(
         "p99_s": float(np.percentile(totals, 99)) if totals else None,
         "p99_admission_wait_s": (float(np.percentile(waits, 99))
                                  if waits else None),
+        "ttft_p50_s": float(np.percentile(ttfts, 50)) if ttfts else None,
+        "ttft_p99_s": float(np.percentile(ttfts, 99)) if ttfts else None,
+        "itl_p50_s": float(np.percentile(itls, 50)) if itls else None,
+        "itl_p99_s": float(np.percentile(itls, 99)) if itls else None,
         "prefix_cache": bool(prefix_cache),
         **({"prefix_queries": pc.queries,
             "prefix_hit_requests": pc.hit_requests,

@@ -19,14 +19,18 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Mapping
 
-# CLI field -> (attr, comparator, value transform).  p99 is spelled in
-# ms on the command line (operators think in ms) but stored in seconds
-# like every other latency in the codebase.
+# CLI field -> (attr, comparator, value transform).  Latencies are
+# spelled in ms on the command line (operators think in ms) but stored
+# in seconds like every other latency in the codebase.
 _FIELDS = {
     "tok_s_chip": ("min_tok_s_per_chip", ">=", 1.0),
     "p99_ms": ("max_p99_s", "<=", 1e-3),
     "headroom": ("min_hbm_headroom_frac", ">=", 1.0),
     "survival": ("min_survival", ">=", 1.0),
+    # p99 time-to-first-token / inter-token latency: predicted by the
+    # serve replay and measured live per window by obs/slo_monitor
+    "ttft_ms": ("max_ttft_p99_s", "<=", 1e-3),
+    "itl_ms": ("max_itl_p99_s", "<=", 1e-3),
 }
 
 
@@ -38,6 +42,8 @@ class SLOSpec:
     max_p99_s: float | None = None
     min_hbm_headroom_frac: float | None = None
     min_survival: float | None = None
+    max_ttft_p99_s: float | None = None
+    max_itl_p99_s: float | None = None
 
     @classmethod
     def parse(cls, text: str | None) -> "SLOSpec":
@@ -99,6 +105,10 @@ class SLOSpec:
         check(pred.get("hbm_headroom_frac"), self.min_hbm_headroom_frac,
               True, "headroom")
         check(pred.get("survival"), self.min_survival, True, "survival")
+        check(pred.get("ttft_p99_s"), self.max_ttft_p99_s,
+              False, "ttft_p99_s")
+        check(pred.get("itl_p99_s"), self.max_itl_p99_s,
+              False, "itl_p99_s")
         if not pred.get("fits", True):
             violations.append("memory: plan does not fit in HBM")
         return (not violations, violations)
