@@ -30,6 +30,14 @@ Usage (all key=value, bench.py-style):
         [attention_impl=paged|dense] [prefill_chunk=8]
         [adapters=0] [adapter_rank=8] [quant_adapters=0] [speculative=0]
         [disaggregate=1] [tp=1] [prefix_cache=1] [shared_prefix=112]
+        [gateway=0] [replicas=1]
+
+``gateway=1`` drives the SAME mix through the real HTTP/SSE ingress
+(inference/gateway): ``replicas=N`` engines behind the prefix-affinity
+router, one blocking SSE client per stream.  Non-canonical (argv
+present), so it never touches SERVE_LAST_GOOD — the number it reports
+is the HTTP/ingress overhead vs the direct-engine run on the same
+knobs (see BENCH_NOTES.md).
 
 r05 makes the canonical run a SHARED-PREFIX mix: every stream's prompt
 opens with the same ``shared_prefix`` seeded tokens (a common system
@@ -91,6 +99,7 @@ def parse_args():
         "adapters": 0, "adapter_rank": 8, "quant_adapters": 0,
         "speculative": 0, "disaggregate": 1, "tp": 1,
         "prefix_cache": 1, "shared_prefix": 112,
+        "gateway": 0, "replicas": 1,
     }
     for item in sys.argv[1:]:
         k, _, v = item.partition("=")
@@ -434,6 +443,145 @@ def run_load(args, journal) -> dict:
     }
 
 
+def run_gateway_load(args, journal) -> dict:
+    """gateway=1: the same shared-prefix mix, but through the REAL
+    HTTP/SSE path — ``replicas=N`` engines behind the prefix-affinity
+    router, an asyncio ingress in a background thread, and one
+    blocking SSE client per stream.  Non-canonical by construction
+    (key=value argv disables the freshness guard): the number this
+    mode exists for is the GATEWAY OVERHEAD — tokens/s and latency
+    through HTTP vs the direct-engine r05 run on the same argv minus
+    ``gateway=1`` — not a new headline.
+    """
+    import asyncio
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torch_automatic_distributed_neural_network_tpu.inference \
+        .gateway import EngineReplica, Gateway, HttpIngress, sse_generate
+    from torch_automatic_distributed_neural_network_tpu.inference.serve \
+        import ServeEngine
+    from torch_automatic_distributed_neural_network_tpu.models import GPT2
+
+    model = GPT2("test", vocab_size=int(args["vocab"]),
+                 max_seq_len=int(args["max_len"]), dtype=jnp.float32,
+                 remat=False)
+    rs = np.random.RandomState(int(args["seed"]))
+    prompt0 = rs.randint(1, int(args["vocab"]),
+                         size=(1, int(args["prompt_len"])))
+    variables = model.init(jax.random.key(1),
+                           jnp.asarray(prompt0, jnp.int32))
+
+    def make(name: str) -> EngineReplica:
+        eng = ServeEngine(
+            model, variables, n_slots=int(args["slots"]),
+            max_len=int(args["max_len"]),
+            block_size=int(args["block_size"]),
+            attention_impl=str(args["attention_impl"]),
+            prefill_chunk=int(args["prefill_chunk"]) or None,
+            prefix_cache=bool(int(args["prefix_cache"])),
+            journal=journal)
+        return EngineReplica(name, eng)
+
+    replicas = [make(f"replica{i}")
+                for i in range(int(args["replicas"]))]
+    gw = Gateway(replicas, journal=journal)
+    loop = asyncio.new_event_loop()
+    ingress = HttpIngress(gw, port=0)
+
+    def _serve():
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(ingress.start())
+        loop.run_forever()
+
+    thread = threading.Thread(target=_serve, daemon=True)
+    thread.start()
+    deadline = time.perf_counter() + 30
+    while not ingress.port and time.perf_counter() < deadline:
+        time.sleep(0.02)
+    if not ingress.port:
+        raise RuntimeError("ingress failed to bind")
+
+    def call(prompt):
+        return sse_generate("127.0.0.1", ingress.port, {
+            "prompt": prompt, "max_new_tokens": int(args["max_new"]),
+            "eos_id": 0}, timeout=300.0)
+
+    # warm the serving executables through the full HTTP path (compile
+    # time is not a gateway number)
+    warm = [int(t) for t in rs.randint(1, int(args["vocab"]),
+                                       size=(int(args["prompt_len"]),))]
+    for _ in range(2):
+        call(warm)
+    for r in replicas:
+        pc = r.engine.prefix_cache
+        if pc is not None:
+            pc.clear()
+
+    n_shared = max(0, min(int(args["shared_prefix"]),
+                          int(args["prompt_len"]) - 1))
+    shared = [int(t) for t in rs.randint(1, int(args["vocab"]),
+                                         size=(n_shared,))]
+    prompts = []
+    for _ in range(int(args["streams"])):
+        suffix = rs.randint(1, int(args["vocab"]),
+                            size=(int(args["prompt_len"]) - n_shared,))
+        prompts.append(shared + [int(t) for t in suffix])
+
+    t0 = time.perf_counter()
+    with ThreadPoolExecutor(max_workers=len(prompts)) as pool:
+        results = list(pool.map(call, prompts))
+    wall = time.perf_counter() - t0
+
+    asyncio.run_coroutine_threadsafe(ingress.stop(), loop).result(30)
+    loop.call_soon_threadsafe(loop.stop)
+    thread.join(timeout=30)
+
+    new_tokens = sum(
+        sum(1 for e in ev if "token" in e) for ev in results)
+    totals = sorted(ev[-1]["usage"].get("total_s") or 0.0
+                    for ev in results if ev and ev[-1].get("done"))
+    prefix = gw.summary()
+    device_kind = jax.devices()[0].device_kind
+    on_cpu = jax.default_backend() == "cpu"
+    metric = ("serve_gateway_tokens_per_sec"
+              + ("_cpu_sim" if on_cpu else ""))
+    value = new_tokens / max(wall, 1e-9)
+    return {
+        "metric": metric,
+        "value": round(value, 2),
+        "unit": "tokens/s",
+        "vs_baseline": 1.0,
+        "extra": {
+            "gateway": {
+                "http": True,
+                "replicas": int(args["replicas"]),
+                "router": prefix["router"],
+                "prefix_hit_tokens": prefix["prefix_hit_tokens"],
+                "accepted": prefix["accepted"],
+                "done": prefix["done"],
+            },
+            "streams": int(args["streams"]),
+            "slots": int(args["slots"]),
+            "prompt_len": int(args["prompt_len"]),
+            "max_new": int(args["max_new"]),
+            "shared_prefix": n_shared,
+            "prefix_cache": bool(int(args["prefix_cache"])),
+            "n_requests": len(results),
+            "new_tokens": new_tokens,
+            "wall_s": round(wall, 4),
+            "p50_ms": round(_pct(totals, 0.50) * 1e3, 2),
+            "p99_ms": round(_pct(totals, 0.99) * 1e3, 2),
+            "device_kind": device_kind,
+            "backend": jax.default_backend(),
+        },
+    }
+
+
 def main():
     # serving scheduling numbers are backend-independent; default to the
     # 8-device CPU sim unless a real accelerator is already visible
@@ -451,7 +599,9 @@ def main():
     try:
         with Journal(jpath, host0_only=False,
                      meta={"tool": "bench_serve"}) as jnl:
-            result = run_load(args, jnl)
+            result = (run_gateway_load(args, jnl)
+                      if int(args.get("gateway", 0))
+                      else run_load(args, jnl))
     except Exception as e:  # noqa: BLE001 — the record IS the report
         log(f"serve bench failed: {type(e).__name__}: {e}")
         last = _load_last_good().get("serve")

@@ -13,7 +13,7 @@ import torch_automatic_distributed_neural_network_tpu as _pkg
 _self = _sys.modules[__name__]
 for _name in ("models", "ops", "parallel", "utils", "data", "training",
               "obs", "tune", "analysis", "inference",
-              "inference.serve", "export"):
+              "inference.serve", "inference.gateway", "export"):
     _mod = _importlib.import_module(_pkg.__name__ + "." + _name)
     _sys.modules.setdefault(__name__ + "." + _name, _mod)
     if "." not in _name:

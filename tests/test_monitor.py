@@ -518,3 +518,46 @@ def test_engine_request_done_timeline():
     windows = list(aggregate_stream(jnl.records, window_s=60.0))
     assert windows and windows[0]["n_done"] == 3
     assert windows[0]["new_tokens"] == 12
+
+
+def test_follow_waits_for_missing_file(tmp_path):
+    # the path does not exist yet (monitor started before the engine's
+    # first event): follow polls for creation, then tails normally
+    path = str(tmp_path / "notyet.jsonl")
+    state = {"polls": 0}
+
+    def feed(_):
+        state["polls"] += 1
+        if state["polls"] == 2:  # created on the second idle poll
+            _write_journal(path, [
+                {"kind": "event", "name": "a", "t": 0.1}])
+
+    got = list(Journal.follow(path, poll_s=1.0, idle_timeout=5.0,
+                              sleep=feed))
+    assert [r["name"] for r in got] == ["a"]
+    assert state["polls"] >= 2
+
+
+def test_follow_missing_file_times_out_quietly(tmp_path):
+    path = str(tmp_path / "never.jsonl")
+    got = list(Journal.follow(path, poll_s=1.0, idle_timeout=2.0,
+                              sleep=lambda s: None))
+    assert got == []
+
+
+def test_follow_missing_file_honors_stop(tmp_path):
+    path = str(tmp_path / "never.jsonl")
+    got = list(Journal.follow(path, stop=lambda: True,
+                              sleep=lambda s: None))
+    assert got == []
+
+
+def test_monitor_cli_follow_accepts_missing_journal(tmp_path, capsys):
+    # without --follow a missing journal is a usage error (exit 2, see
+    # test_monitor_cli_replay_check_exit_codes); WITH --follow it waits
+    # under --idle-timeout and exits 0 on a quiet timeout
+    missing = str(tmp_path / "notyet.jsonl")
+    assert cli.main([
+        "monitor", missing, "--follow", "--idle-timeout", "0.5",
+        "--slo", "p99_ms<=2500"]) == 0
+    capsys.readouterr()

@@ -423,3 +423,81 @@ def test_serve_estimate_charges_prefix_index_and_dedupes_streams():
     with pytest.raises(ValueError, match="expected_hit_rate"):
         serve_estimate(_cfg(), budget=1 << 22, block_size=8, max_len=64,
                        prefix_cache=True, expected_hit_rate=1.0)
+
+
+# -- TTL leases (gateway r17) --------------------------------------------------
+
+
+def test_ttl_expiry_is_lazy_and_journaled():
+    from torch_automatic_distributed_neural_network_tpu.obs.journal import (
+        Journal,
+    )
+
+    alloc = BlockAllocator(16)
+    clock = [0.0]
+    jnl = Journal(None, host0_only=False)
+    pc = PrefixCache(block_size=8, allocator=alloc,
+                     clock=lambda: clock[0], journal=jnl)
+    leased = alloc.acquire(2)
+    forever = alloc.acquire(1)
+    pc.insert([1] * 16, leased, ttl_s=5.0)
+    pc.insert([2] * 8, forever)  # no lease: lives until LRU eviction
+    alloc.release(leased)
+    alloc.release(forever)
+    clock[0] = 4.9
+    assert pc.match([1] * 16)[1] == 16  # still live
+    assert pc.expired_blocks == 0
+    clock[0] = 5.1
+    # expiry is lazy: the next match sweeps the lease before walking
+    assert pc.match([1] * 16) == ([], 0)
+    assert pc.expired_blocks == 2
+    assert pc.match([2] * 8)[1] == 8  # the unleased entry survives
+    expire_events = [r for r in jnl.records
+                     if r.get("name") == "serve.prefix"
+                     and r.get("kind") == "expire"]
+    assert len(expire_events) == 1
+    assert expire_events[0]["n_blocks"] == 2
+
+
+def test_ttl_republish_refreshes_lease():
+    pc, alloc, clock = _mk_index()
+    owner = alloc.acquire(1)
+    pc.insert([1] * 8, owner, ttl_s=5.0)
+    clock[0] = 4.0
+    dup = alloc.acquire(1)
+    pc.insert([1] * 8, dup, ttl_s=5.0)  # re-publish extends to t=9
+    alloc.release(owner)
+    alloc.release(dup)
+    clock[0] = 6.0
+    assert pc.match([1] * 8)[1] == 8  # old deadline passed, lease held
+    clock[0] = 9.5
+    assert pc.match([1] * 8) == ([], 0)
+    assert pc.expired_blocks == 1
+
+
+def test_ttl_evict_counts_expired_toward_shortfall():
+    pc, alloc, clock = _mk_index()
+    leased = alloc.acquire(2)
+    pc.insert([1] * 16, leased, ttl_s=1.0)
+    alloc.release(leased)
+    clock[0] = 2.0
+    # evict() sweeps leases first; the shortfall is already covered so
+    # no LRU eviction happens on top
+    assert pc.evict(1) == 2
+    assert pc.n_blocks == 0 and alloc.n_live == 0
+
+
+def test_ttl_referenced_blocks_stop_serving_but_free_lazily():
+    pc, alloc, clock = _mk_index()
+    owner = alloc.acquire(1)
+    pc.insert([1] * 8, owner, ttl_s=1.0)
+    clock[0] = 5.0
+    # the lease is past due: the content must no longer be SERVED even
+    # though the publisher's live ref pins the block — staleness and
+    # memory reclaim are separate deadlines
+    assert pc.match([1] * 8) == ([], 0)
+    assert pc.expire() == 0  # referenced: not freeable yet
+    assert pc.n_blocks == 1
+    alloc.release(owner)
+    assert pc.expire() == 1  # ref gone: the sweep reclaims it
+    assert pc.n_blocks == 0 and alloc.n_live == 0

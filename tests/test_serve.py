@@ -569,3 +569,61 @@ def test_scheduler_injected_clock_drives_timestamps():
     clock[0] = 107.5
     sched.evict(req.slot)
     assert req.t_done == 107.5
+
+
+# -- priority classes (gateway r17) --------------------------------------------
+
+
+def test_priority_orders_admission_under_reserve():
+    # 5 allocatable blocks, 2-block reservations: two admits per round.
+    # A batch-class request (priority 1) submitted FIRST must yield to
+    # interactive (priority 0) requests submitted after it.
+    s = _mk_sched(num_blocks=6)
+    batch = Request(prompt=[1] * 10, max_new_tokens=6, priority=1)
+    int_a = Request(prompt=[2] * 10, max_new_tokens=6, priority=0)
+    int_b = Request(prompt=[3] * 10, max_new_tokens=6, priority=0)
+    for r in (batch, int_a, int_b):
+        s.submit(r)
+    admitted = s.admit()
+    assert [r.rid for _, r in admitted] == [int_a.rid, int_b.rid]
+    assert s.n_queued == 1  # batch waits
+    s.check_invariants()
+    int_a.out_tokens = [5] * 6
+    s.evict(0)
+    assert [r.rid for _, r in s.admit()] == [batch.rid]
+    s.check_invariants()
+
+
+def test_priority_fifo_within_class_and_default_is_legacy_order():
+    s = _mk_sched(num_blocks=20, n_slots=6)
+    # same class: strict submission order (t_submit then rid)
+    reqs = [Request(prompt=[i + 1] * 10, max_new_tokens=6, priority=1)
+            for i in range(3)]
+    for r in reqs:
+        s.submit(r)
+    assert [q.rid for q in s.queue] == [r.rid for r in reqs]
+    # default priority 0 degenerates to pure FIFO with earlier zeros
+    plain = Request(prompt=[9] * 10, max_new_tokens=6)
+    assert plain.priority == 0
+    s.submit(plain)
+    assert [q.rid for q in s.queue][0] == plain.rid
+    admitted = s.admit()
+    assert [r.rid for _, r in admitted] == (
+        [plain.rid] + [r.rid for r in reqs])
+
+
+def test_priority_requeue_keeps_class_position():
+    # a preempted interactive request goes back AHEAD of queued batch
+    # work, behind nothing of its own class that submitted earlier
+    # (3 allocatable blocks: only ONE 2-block reservation fits, so the
+    # batch request is still queued when the interactive one bounces)
+    s = _mk_sched(num_blocks=4)
+    inter = Request(prompt=[1] * 10, max_new_tokens=6, priority=0)
+    batch = Request(prompt=[2] * 10, max_new_tokens=6, priority=1)
+    s.submit(inter)
+    s.submit(batch)
+    admitted = s.admit()
+    assert [r.rid for _, r in admitted] == [inter.rid]
+    s.requeue(admitted[0][0])
+    assert [q.rid for q in s.queue] == [inter.rid, batch.rid]
+    s.check_invariants()

@@ -616,7 +616,10 @@ def cmd_monitor(args: argparse.Namespace) -> int:
     except ValueError as e:
         print(f"monitor: {e}", file=sys.stderr)
         return 2
-    if not os.path.isfile(args.journal):
+    if not args.follow and not os.path.isfile(args.journal):
+        # --follow accepts a not-yet-created journal (a gateway starts
+        # its monitor before first traffic): Journal.follow polls for
+        # the file under --idle-timeout instead of raising
         print(f"monitor: no journal at {args.journal}", file=sys.stderr)
         return 2
     policy = slm.MonitorPolicy(
@@ -1137,6 +1140,86 @@ def cmd_serve(args: argparse.Namespace) -> int:
         print(f"smoke: expected {streams} finished requests, got "
               f"{len(done)}", file=sys.stderr)
         return 1
+    return 0
+
+
+def cmd_gateway(args: argparse.Namespace) -> int:
+    """Online serving gateway (inference/gateway): multi-replica
+    ingress with prefix-affinity routing and the closed-loop SLO
+    autoscaler.
+
+    ``--smoke`` runs the virtual-clock chaos scenario twice (traffic
+    flip → SLO breach → replan → scale-out → recover) and checks the
+    two journals are byte-identical — the CI gate.  ``--port`` starts
+    a real asyncio HTTP/SSE server over ``--replicas`` tiny engines
+    (the ``tadnn serve --smoke`` model) for interactive use.
+    """
+    from .inference.gateway import chaos_smoke
+
+    if args.smoke:
+        out = chaos_smoke(
+            journal_path=args.journal,
+            n_replicas=args.replicas,
+            slo_text=args.slo,
+            max_replicas=args.max_replicas,
+            scale=args.scale,
+            autoscale=args.autoscale)
+        print(json.dumps(out))
+        if not out["ok"]:
+            for flag in ("deterministic", "closed_loop"):
+                if not out[flag]:
+                    print(f"gateway smoke: {flag} check failed",
+                          file=sys.stderr)
+            return 1
+        return 0
+    if not args.port:
+        print("tadnn gateway needs --smoke or --port", file=sys.stderr)
+        return 2
+
+    import asyncio
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .inference.gateway import (
+        AutoscalePolicy, EngineReplica, Gateway, serve_forever)
+    from .inference.serve import ServeEngine
+    from .models import GPT2
+    from .obs.journal import Journal
+    from .tune.slo import SLOSpec
+
+    model = GPT2("test", max_seq_len=args.max_len, vocab_size=128,
+                 dtype=jnp.float32, remat=False)
+    rs = np.random.RandomState(args.seed)
+    sample = jnp.asarray(rs.randint(1, 128, size=(1, 10)), jnp.int32)
+    variables = model.init(jax.random.key(1), sample)
+
+    with Journal(args.journal, host0_only=False,
+                 meta={"tool": "gateway"}) as jnl:
+        def make(name: str) -> EngineReplica:
+            eng = ServeEngine(model, variables, n_slots=args.slots,
+                              max_len=args.max_len, block_size=8,
+                              prefix_cache=True, journal=jnl)
+            return EngineReplica(name, eng)
+
+        replicas = [make(f"replica{i}") for i in range(args.replicas)]
+        policy = (AutoscalePolicy(slo=SLOSpec.parse(args.slo))
+                  if args.autoscale else None)
+        gw = Gateway(replicas, journal=jnl, autoscale=policy,
+                     make_replica=make if args.autoscale else None,
+                     rate_limit_per_s=args.rate_limit,
+                     queue_limit=args.queue_limit)
+        print(json.dumps({"listening": True, "host": args.host,
+                          "port": args.port,
+                          "replicas": args.replicas,
+                          "autoscale": bool(args.autoscale),
+                          "journal": args.journal}))
+        try:
+            asyncio.run(serve_forever(gw, host=args.host,
+                                      port=args.port))
+        except KeyboardInterrupt:
+            pass
     return 0
 
 
@@ -1967,6 +2050,57 @@ def main(argv: list[str] | None = None) -> int:
                         "over the data axis (the per-chip optimizer row "
                         "drops ~DP-fold)")
     p.set_defaults(fn=cmd_check)
+
+    p = sub.add_parser(
+        "gateway",
+        help="online serving gateway: multi-replica SSE ingress with "
+             "prefix-affinity routing and a closed-loop SLO "
+             "autoscaler; --smoke replays the chaos scenario twice "
+             "and asserts byte-identical journals",
+    )
+    p.add_argument("--smoke", action="store_true",
+                   help="run the virtual-clock chaos autoscale "
+                        "scenario (breach → replan → scale → recover) "
+                        "twice and verify determinism; exit 1 on any "
+                        "failed check")
+    p.add_argument("--replicas", type=int, default=2,
+                   help="initial fleet size")
+    p.add_argument("--max-replicas", type=int, default=8,
+                   dest="max_replicas",
+                   help="autoscaler ceiling (smoke: the scale-out "
+                        "target under the traffic flip)")
+    p.add_argument("--autoscale", action="store_true",
+                   help="enable the closed-loop SLO autoscaler")
+    p.add_argument("--slo", default="p99_ms<=2500",
+                   help="SLO spec the monitor/autoscaler enforce "
+                        "(tune/slo grammar, e.g. 'p99_ms<=2500,"
+                        "ttft_p99_ms<=1000')")
+    p.add_argument("--scale", default="smoke",
+                   choices=["smoke", "light", "gentle"],
+                   help="chaos scenario size (light = fast tier-1 "
+                        "variant; gentle = no traffic flip)")
+    p.add_argument("--journal", default=None,
+                   help="journal JSONL path (smoke: run 1's journal, "
+                        "the CI artifact; --port: the live journal "
+                        "tadnn monitor can follow)")
+    p.add_argument("--port", type=int, default=0,
+                   help="start a real HTTP/SSE ingress on this port "
+                        "(POST /v1/generate, GET /healthz)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--slots", type=int, default=4,
+                   help="serving slots per replica (--port mode)")
+    p.add_argument("--max-len", type=int, default=64, dest="max_len",
+                   help="per-replica context length (--port mode)")
+    p.add_argument("--rate-limit", type=float, default=None,
+                   dest="rate_limit", metavar="R",
+                   help="per-tenant sustained requests/s "
+                        "(token bucket; default unlimited)")
+    p.add_argument("--queue-limit", type=int, default=64,
+                   dest="queue_limit",
+                   help="per-tenant in-flight cap before 503 "
+                        "backpressure")
+    p.add_argument("--seed", type=int, default=0)
+    p.set_defaults(fn=cmd_gateway)
 
     p = sub.add_parser(
         "tokenize",

@@ -1,0 +1,51 @@
+"""Online serving gateway: ingress, routing, and closed-loop autoscale.
+
+Three layers over the continuous-batching serve engine:
+
+- :mod:`.ingress` — :class:`Gateway` (admission: token-bucket rate
+  limits, bounded per-tenant queues, priority classes) plus
+  :class:`HttpIngress`, a stdlib-asyncio HTTP server streaming tokens
+  over SSE;
+- :mod:`.router` — :class:`Router` places requests on the replica
+  already owning the deepest cached prefix (by the radix index's
+  chained block hashes), falling back to least-loaded;
+- :mod:`.controller` — :class:`FleetController` watches live SLO
+  windows and resizes the fleet through the planner's serving replay.
+
+:mod:`.chaos` scripts the whole loop on a virtual clock (traffic flip
+→ breach → replan → recover) as a byte-replayable smoke scenario —
+``tadnn gateway --smoke`` in CI.
+"""
+
+from .chaos import chaos_smoke, run_scenario
+from .controller import AutoscalePolicy, FleetController
+from .ingress import (
+    Gateway,
+    GatewayError,
+    HttpIngress,
+    RateLimited,
+    Saturated,
+    TokenBucket,
+    serve_forever,
+    sse_generate,
+)
+from .router import EngineReplica, NoHealthyReplica, Router, SimReplica
+
+__all__ = [
+    "AutoscalePolicy",
+    "EngineReplica",
+    "FleetController",
+    "Gateway",
+    "GatewayError",
+    "HttpIngress",
+    "NoHealthyReplica",
+    "RateLimited",
+    "Router",
+    "Saturated",
+    "SimReplica",
+    "TokenBucket",
+    "chaos_smoke",
+    "run_scenario",
+    "serve_forever",
+    "sse_generate",
+]

@@ -1,0 +1,226 @@
+"""Chaos-traffic autoscale scenario: the gateway's closed-loop proof.
+
+A scripted traffic schedule — gentle mix, a mid-run flip to a heavy
+mix (higher rate AND longer decodes), then back — drives a fleet of
+:class:`~.router.SimReplica` under an autoscaling
+:class:`~.ingress.Gateway`, everything on one virtual clock:
+
+1. the flip saturates the fleet; measured p99 climbs through the SLO;
+2. ``SLOMonitor`` journals ``slo.breach`` after its hysteresis count;
+3. the controller replans from the measured mix via the serving
+   replay and journals ``gateway.replan`` + ``gateway.scale`` events
+   as it grows the fleet;
+4. the grown fleet drains the backlog; windows go clean;
+   ``slo.recover`` lands.
+
+Because every timestamp, arrival, admission decision and journal
+record derives from the injected clock and seeded mixes, running the
+scenario twice produces an IDENTICAL event sequence — ``chaos_smoke``
+runs it twice and diffs the normalized journals, which is the CI
+gate's determinism assertion (no sleeps, no wall-clock reads, no
+tolerance bands).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+from ...obs.journal import Journal
+from ...tune.simulate import TrafficMix
+from ...tune.slo import SLOSpec
+from .controller import AutoscalePolicy
+from .ingress import Gateway, GatewayError
+from .router import SimReplica
+
+# shared system-prompt head: identical across every request so the
+# radix index (and the router's affinity map) has something to reuse
+SHARED_PREFIX = 16
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosPhase:
+    t0: float
+    span_s: float
+    mix: TrafficMix
+
+
+def phases(scale: str = "smoke") -> list[ChaosPhase]:
+    """The scripted schedule.  ``smoke`` is the CI scenario (2 -> ~8
+    replicas); ``light`` is the faster tier-1 test variant."""
+
+    def mix(rate, max_new, seed, n):
+        return TrafficMix(rate_per_s=rate, n_requests=n,
+                          prompt_mean=24, max_new=max_new,
+                          decode_mean=max_new, jitter=0.0, seed=seed,
+                          shared_prefix=SHARED_PREFIX)
+
+    if scale == "gentle":
+        # no flip: a healthy run whose journal must pass
+        # ``tadnn monitor --replay --check`` with exit 0
+        return [ChaosPhase(0.0, 4.0, mix(40.0, 8, 11, 200))]
+    if scale == "light":
+        return [
+            ChaosPhase(0.0, 4.0, mix(40.0, 8, 11, 200)),
+            ChaosPhase(4.0, 6.0, mix(240.0, 12, 12, 1700)),
+            ChaosPhase(10.0, 6.0, mix(40.0, 8, 13, 280)),
+        ]
+    return [
+        ChaosPhase(0.0, 6.0, mix(60.0, 8, 11, 420)),
+        ChaosPhase(6.0, 10.0, mix(300.0, 16, 12, 3400)),
+        ChaosPhase(16.0, 8.0, mix(60.0, 8, 13, 560)),
+    ]
+
+
+def arrivals(schedule: list[ChaosPhase], *, n_tenants: int = 8
+             ) -> list[tuple[float, list[int], int, int, str]]:
+    """Flatten the schedule into absolute-time submissions:
+    ``(t, prompt, max_new, n_decode, tenant)``.  Prompts share a
+    ``SHARED_PREFIX``-token head; tails are unique per request."""
+    out: list[tuple[float, list[int], int, int, str]] = []
+    uid = 0
+    for phase in schedule:
+        for arr, n_prompt, max_new, n_dec in phase.mix.sample(
+                max_len=256):
+            if arr > phase.span_s:
+                break
+            n_shared = min(SHARED_PREFIX, n_prompt - 1)
+            prompt = ([1] * n_shared
+                      + [100 + uid] * (n_prompt - n_shared))
+            out.append((phase.t0 + arr, prompt, max_new, n_dec,
+                        f"t{uid % n_tenants}"))
+            uid += 1
+    out.sort(key=lambda a: a[0])
+    return out
+
+
+def default_policy(slo_text: str = "p99_ms<=2500", *,
+                   max_replicas: int = 8) -> AutoscalePolicy:
+    return AutoscalePolicy(
+        slo=SLOSpec.parse(slo_text), window_s=1.0,
+        breach_after=2, recover_after=2, warmup_windows=1,
+        min_replicas=1, max_replicas=max_replicas,
+        cooldown_windows=3, scale_in_after=10_000)
+
+
+def run_scenario(journal: Journal, *, clock: list[float] | None = None,
+                 n_replicas: int = 2,
+                 policy: AutoscalePolicy | None = None,
+                 scale: str = "smoke", tick_s: float = 5e-3,
+                 horizon_s: float = 90.0) -> dict:
+    """One full pass of the scenario on a virtual clock; returns the
+    gateway summary (the journal carries the event record).
+
+    ``clock`` is a one-element list (the mutable time box) so the
+    caller can hand the SAME virtual clock to the journal — the
+    journal's ``t`` stamps must be virtual or the byte-for-byte
+    determinism diff would see wall time."""
+    policy = policy or default_policy()
+    if clock is None:
+        clock = [0.0]
+
+    def now() -> float:
+        return clock[0]
+
+    def make(name: str) -> SimReplica:
+        return SimReplica(name, n_slots=4, block_size=8, max_len=256,
+                          prefill_chunk=8, clock=now, journal=journal)
+
+    replicas = [make(f"replica{i}") for i in range(n_replicas)]
+    gw = Gateway(replicas, journal=journal, clock=now,
+                 autoscale=policy, make_replica=make, queue_limit=48,
+                 step_costs=(tick_s, tick_s))
+    plan = arrivals(phases(scale))
+    i = 0
+    while clock[0] < horizon_s and (i < len(plan) or not gw.idle()):
+        t = clock[0]
+        while i < len(plan) and plan[i][0] <= t:
+            _, prompt, max_new, n_dec, tenant = plan[i]
+            try:
+                gw.submit(prompt, max_new, tenant=tenant, eos_id=0,
+                          n_decode=n_dec)
+            except GatewayError:
+                pass  # counted by the gateway; journaled
+            i += 1
+        gw.step()
+        clock[0] = t + tick_s
+    if gw.controller is not None:
+        gw.controller.finish()
+    summary = gw.summary()
+    summary["offered"] = len(plan)
+    summary["virtual_s"] = clock[0]
+    return summary
+
+
+def _normalize(records: list[dict]) -> list[str]:
+    """Canonical form for the determinism diff: drop the one
+    legitimately nondeterministic field (wall time) and re-serialize.
+    Everything else — virtual timestamps, decisions, counters — must
+    match byte-for-byte across runs."""
+    out = []
+    for rec in records:
+        out.append(json.dumps({k: v for k, v in rec.items()
+                               if k != "wall"}, default=str))
+    return out
+
+
+def chaos_smoke(*, journal_path: str | None = None,
+                n_replicas: int = 2, slo_text: str = "p99_ms<=2500",
+                max_replicas: int = 8, scale: str = "smoke",
+                autoscale: bool = True) -> dict:
+    """Run the scenario TWICE (file-backed then in-memory journal),
+    diff the normalized event sequences, and check the closed loop
+    actually closed: breach -> replan -> scale -> recover, in order.
+
+    Returns a summary dict with ``ok`` plus per-assertion booleans —
+    the CLI smoke prints it as one JSON line and exits nonzero unless
+    everything held."""
+    policy = (default_policy(slo_text, max_replicas=max_replicas)
+              if autoscale else None)
+
+    def one(path: str | None) -> tuple[dict, list[dict]]:
+        clock = [0.0]
+        # the journal shares the scenario's virtual clock so record
+        # ``t`` stamps are event-time, not wall-time — the whole point
+        # of the twice-run diff below
+        j = Journal(path, host0_only=False, clock=lambda: clock[0],
+                    meta={"tool": "gateway-chaos"})
+        with j:
+            summary = run_scenario(j, clock=clock,
+                                   n_replicas=n_replicas,
+                                   policy=policy, scale=scale)
+        records = (Journal.read(path) if path else list(j.records))
+        return summary, records
+
+    s1, r1 = one(journal_path)
+    s2, r2 = one(None)
+    seq1, seq2 = _normalize(r1), _normalize(r2)
+    deterministic = seq1 == seq2
+
+    def first_index(name: str) -> int:
+        for idx, rec in enumerate(r1):
+            if rec.get("name") == name:
+                return idx
+        return -1
+
+    i_breach = first_index("slo.breach")
+    i_replan = first_index("gateway.replan")
+    i_scale = first_index("gateway.scale")
+    i_recover = first_index("slo.recover")
+    closed_loop = (0 <= i_breach <= i_replan <= i_scale
+                   and i_scale <= i_recover) if autoscale else True
+    ok = deterministic and closed_loop and s1["done"] > 0
+    return {
+        "ok": ok,
+        "deterministic": deterministic,
+        "closed_loop": closed_loop,
+        "breach_at": i_breach, "replan_at": i_replan,
+        "scale_at": i_scale, "recover_at": i_recover,
+        "n_records": len(r1),
+        "record_mismatch": (None if deterministic else next(
+            (i for i, (a, b) in enumerate(zip(seq1, seq2)) if a != b),
+            min(len(seq1), len(seq2)))),
+        "names_seen": sorted({rec.get("name") for rec in r1
+                              if rec.get("name")}),
+        "run": s1,
+    }

@@ -1,0 +1,565 @@
+"""Async ingress: HTTP/SSE front door over a replica fleet.
+
+Stdlib only (asyncio + json): the gateway is part of the serving
+runtime, not a web-framework dependency.  One process owns N replicas,
+a :class:`~.router.Router` placing requests by prefix affinity, and
+(optionally) a :class:`~.controller.FleetController` resizing the
+fleet against its SLO.  The HTTP layer streams tokens per request as
+Server-Sent Events::
+
+    POST /v1/generate        {"prompt": [1,2,3], "max_new_tokens": 16,
+                              "tenant": "acme", "priority": "interactive"}
+    -> 200 text/event-stream
+       data: {"i": 0, "token": 42}
+       ...
+       data: {"done": true, "rid": 7, "usage": {...}}
+
+Admission control happens BEFORE the scheduler ever sees a request:
+
+- token-bucket rate limit per tenant (429; burst-tolerant, refilled on
+  the injected clock);
+- bounded in-flight queue per tenant (503 backpressure: a slow tenant
+  queues against itself, not the fleet);
+- priority classes ("interactive" < "batch") mapped onto
+  ``Request.priority``, which the scheduler orders admission by.
+
+Requests then flow through the SAME ``Scheduler``/``admission_plan``
+interface and stamp the SAME ``serve.request_done`` spans as the
+direct-engine path, so ``obs/live``, ``tadnn monitor`` and ``tadnn
+report`` work unchanged on a gateway journal.
+
+The :class:`Gateway` core is sync and clock-injected; the asyncio
+server is a thin pump around it.  Tests and the chaos smoke drive
+``Gateway.step()`` directly on virtual time — no sockets, no sleeps.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from collections import deque
+from typing import Any, Callable, Sequence
+
+from ...obs import journal as journal_mod
+from ...obs.journal import Journal
+from ..serve.scheduler import Request
+from .controller import AutoscalePolicy, FleetController
+from .router import NoHealthyReplica, Router
+
+PRIORITY_CLASSES = {"interactive": 0, "batch": 1}
+
+
+class GatewayError(RuntimeError):
+    status = 500
+
+
+class RateLimited(GatewayError):
+    """Tenant exceeded its token-bucket rate (HTTP 429)."""
+    status = 429
+
+
+class Saturated(GatewayError):
+    """Tenant's in-flight queue is full (HTTP 503 backpressure)."""
+    status = 503
+
+
+class TokenBucket:
+    """Classic token bucket on an injected clock: ``rate_per_s``
+    sustained, ``burst`` instantaneous."""
+
+    def __init__(self, rate_per_s: float, burst: int, *,
+                 clock: Callable[[], float] = time.monotonic):
+        self.rate = float(rate_per_s)
+        self.burst = float(burst)
+        self.clock = clock
+        self.tokens = float(burst)
+        self._last = clock()
+
+    def try_take(self) -> bool:
+        now = self.clock()
+        self.tokens = min(self.burst,
+                          self.tokens + (now - self._last) * self.rate)
+        self._last = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True
+        return False
+
+
+class Gateway:
+    """Sync, clock-injected gateway core: admission control, routing,
+    fleet stepping, elastic resize.  The asyncio server and the chaos
+    smoke are both thin loops over ``submit``/``step``."""
+
+    def __init__(self, replicas: Sequence, *,
+                 journal: Journal | None = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 router: Router | None = None,
+                 autoscale: AutoscalePolicy | None = None,
+                 make_replica: Callable[[str], Any] | None = None,
+                 rate_limit_per_s: float | None = None,
+                 burst: int | None = None,
+                 queue_limit: int = 64,
+                 router_policy: str = "affinity",
+                 step_costs: tuple[float, float] = (1e-3, 1e-3),
+                 traffic_horizon_s: float = 8.0):
+        if not replicas:
+            raise ValueError("gateway needs at least one replica")
+        self.clock = clock
+        self.journal = (journal if journal is not None
+                        else journal_mod.get_default())
+        self.router = router or Router(
+            replicas, block_size=replicas[0].block_size,
+            policy=router_policy, clock=clock, journal=self.journal)
+        self.make_replica = make_replica
+        self._next_replica_idx = len(self.router.replicas)
+        self.rate_limit_per_s = rate_limit_per_s
+        self.burst = burst or (int(rate_limit_per_s * 2)
+                               if rate_limit_per_s else 0)
+        self.queue_limit = int(queue_limit)
+        # (prefill_chunk_s, decode_step_s): the candidate-replay cost
+        # model for the controller; SimReplica fleets pass the tick
+        self.step_costs = step_costs
+        self.traffic_horizon_s = float(traffic_horizon_s)
+        self._buckets: dict[str, TokenBucket] = {}
+        self._pending: dict[str, int] = {}       # tenant -> in flight
+        self._meta: dict[int, dict] = {}         # rid -> bookkeeping
+        # gateway-minted request ids: per-gateway, starting at 0, so a
+        # virtual-clock scenario journals the SAME rids every run (the
+        # scheduler's module-global counter is process-lifetime)
+        self._next_rid = 0
+        self._submits: deque = deque()           # (t, n_prompt, max_new, n_dec)
+        self.n_accepted = 0
+        self.n_rejected = 0
+        self.n_done = 0
+        self.controller = (FleetController(self, autoscale,
+                                           journal=self.journal)
+                           if autoscale is not None else None)
+        if self.controller is not None:
+            self.journal.subscribe(self.controller.offer)
+
+    # -- admission -----------------------------------------------------------
+
+    def _bucket(self, tenant: str) -> TokenBucket | None:
+        if not self.rate_limit_per_s:
+            return None
+        b = self._buckets.get(tenant)
+        if b is None:
+            b = self._buckets[tenant] = TokenBucket(
+                self.rate_limit_per_s, self.burst, clock=self.clock)
+        return b
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int, *,
+               tenant: str = "default",
+               priority: int | str = "interactive",
+               eos_id: int | None = None,
+               n_decode: int | None = None) -> Request:
+        """Admission-check, route, and queue one request.  Raises
+        :class:`RateLimited` / :class:`Saturated` with the HTTP status
+        the server maps them to; both are journaled so rejected load is
+        visible in the report."""
+        if isinstance(priority, str):
+            if priority not in PRIORITY_CLASSES:
+                raise ValueError(
+                    f"unknown priority class {priority!r} "
+                    f"(known: {sorted(PRIORITY_CLASSES)})")
+            priority = PRIORITY_CLASSES[priority]
+        # traffic is recorded at OFFER time, before admission: the
+        # controller plans capacity against what clients are asking
+        # for — planning against post-throttle throughput is the
+        # classic autoscaler trap (a saturated fleet rejects its way
+        # to a "healthy" accepted rate and never scales)
+        self._submits.append((self.clock(), len(prompt),
+                              int(max_new_tokens),
+                              int(n_decode or max_new_tokens)))
+        bucket = self._bucket(tenant)
+        if bucket is not None and not bucket.try_take():
+            self.n_rejected += 1
+            self.journal.event("gateway.reject", kind="rate_limit",
+                              tenant=tenant)
+            raise RateLimited(f"tenant {tenant!r} over rate limit")
+        if self._pending.get(tenant, 0) >= self.queue_limit:
+            self.n_rejected += 1
+            self.journal.event("gateway.reject", kind="backpressure",
+                              tenant=tenant,
+                              pending=self._pending[tenant])
+            raise Saturated(
+                f"tenant {tenant!r} has {self._pending[tenant]} "
+                f"requests in flight (limit {self.queue_limit})")
+        replica = self.router.route(prompt)
+        rid = self._next_rid
+        self._next_rid += 1
+        req = replica.submit(prompt, max_new_tokens, eos_id=eos_id,
+                             priority=int(priority), n_decode=n_decode,
+                             rid=rid)
+        self._pending[tenant] = self._pending.get(tenant, 0) + 1
+        self._meta[req.rid] = {"tenant": tenant, "replica": replica,
+                               "n_decode": n_decode, "req": req}
+        self.n_accepted += 1
+        self.journal.event("gateway.request", rid=req.rid,
+                           tenant=tenant, priority=int(priority),
+                           replica=replica.name, n_prompt=len(prompt))
+        return req
+
+    # -- serving loop --------------------------------------------------------
+
+    def active_replicas(self) -> list:
+        return [r for r in self.router.replicas
+                if not r.retired and not r.draining]
+
+    def n_active_replicas(self) -> int:
+        return len(self.active_replicas())
+
+    def idle(self) -> bool:
+        return all(r.idle() for r in self.active_replicas())
+
+    def step(self) -> list[Request]:
+        """Advance every active replica one iteration; returns the
+        requests that finished this step (pending counts released).
+        The journal tap feeds the controller as records are written —
+        a breach detected in this step's windows can resize the fleet
+        before the next step."""
+        finished: list[Request] = []
+        for r in list(self.router.replicas):
+            if r.retired:
+                continue
+            r.step()
+            finished.extend(r.take_finished())
+        for req in finished:
+            meta = self._meta.pop(req.rid, None)
+            if meta is not None:
+                t = meta["tenant"]
+                self._pending[t] = max(0, self._pending.get(t, 1) - 1)
+            self.n_done += 1
+        return finished
+
+    def run_until_idle(self, *, max_steps: int = 100_000
+                       ) -> list[Request]:
+        out: list[Request] = []
+        for _ in range(max_steps):
+            if self.idle():
+                break
+            out.extend(self.step())
+        return out
+
+    # -- elastic resize ------------------------------------------------------
+
+    def replica_shape(self) -> dict:
+        """The active replicas' scheduling shape, for the controller's
+        candidate replay (homogeneous fleet assumed)."""
+        r = self.active_replicas()[0]
+        return {
+            "n_slots": r.n_slots,
+            "block_size": r.block_size,
+            "max_len": r.max_len,
+            "admission": getattr(r, "admission", "reserve"),
+            "prefill_chunk": getattr(r, "prefill_chunk", 32) or 32,
+            "prefill_chunks_per_step": getattr(
+                r, "prefill_chunks_per_step", 1),
+            "prefix_cache": getattr(r, "prefix_cache", None) is not None,
+            "prefill_chunk_s": self.step_costs[0],
+            "decode_step_s": self.step_costs[1],
+        }
+
+    def traffic_snapshot(self) -> dict:
+        """The measured arrival process over the trailing horizon —
+        what the controller simulates candidate fleets against."""
+        now = self.clock()
+        horizon = self.traffic_horizon_s
+        while self._submits and self._submits[0][0] < now - horizon:
+            self._submits.popleft()
+        subs = list(self._submits)
+        if not subs:
+            return {"rate_per_s": 0.0, "prompt_mean": 1, "max_new": 1,
+                    "decode_mean": 1, "shared_prefix": 0}
+        span = max(now - subs[0][0], 1e-9)
+        return {
+            "rate_per_s": len(subs) / span,
+            "prompt_mean": sum(s[1] for s in subs) / len(subs),
+            "max_new": max(s[2] for s in subs),
+            "decode_mean": sum(s[3] for s in subs) / len(subs),
+            "shared_prefix": 0,
+        }
+
+    def scale_to(self, n: int, *, reason: str = "manual") -> None:
+        """Resize the active fleet to ``n`` replicas.
+
+        Scale-out: the ``make_replica`` factory builds each new replica
+        (an engine factory resolves the export cache there, so the new
+        engine's decode/prefill executables load AOT-compiled instead
+        of tracing — the prewarm that makes scale-out fast).  Scale-in:
+        retire the youngest replicas, drain each through the
+        scheduler's requeue path, forget its router claims, and
+        resubmit its requests through the router."""
+        n = max(1, int(n))
+        while self.n_active_replicas() < n:
+            if self.make_replica is None:
+                self.journal.event("gateway.scale", kind="blocked",
+                                   reason="no replica factory")
+                break
+            name = f"replica{self._next_replica_idx}"
+            self._next_replica_idx += 1
+            replica = self.make_replica(name)
+            self.router.replicas.append(replica)
+            self.journal.event(
+                "gateway.scale", kind="out", replica=name,
+                reason=reason, n_replicas=self.n_active_replicas(),
+                prewarmed=bool(getattr(replica, "prewarmed", False)))
+        while self.n_active_replicas() > n:
+            victim = self.active_replicas()[-1]
+            drained = victim.drain()
+            self.router.forget(victim.name)
+            self.journal.event(
+                "gateway.scale", kind="in", replica=victim.name,
+                reason=reason, requeued=len(drained),
+                n_replicas=self.n_active_replicas())
+            for req in drained:
+                meta = self._meta.get(req.rid)
+                target = self.router.route(req.prompt)
+                target.resubmit(
+                    req, n_decode=(meta or {}).get("n_decode"))
+                if meta is not None:
+                    meta["replica"] = target
+
+    # -- summary -------------------------------------------------------------
+
+    def summary(self) -> dict:
+        prefix = [r.prefix_stats() for r in self.router.replicas]
+        out = {
+            "n_replicas": self.n_active_replicas(),
+            "accepted": self.n_accepted,
+            "rejected": self.n_rejected,
+            "done": self.n_done,
+            "router": self.router.stats(),
+            "prefix_hit_tokens": sum(p["hit_tokens"] for p in prefix),
+            "prefix_queries": sum(p["queries"] for p in prefix),
+            "prefix_hit_requests": sum(p["hit_requests"]
+                                       for p in prefix),
+        }
+        if self.controller is not None:
+            out["controller"] = self.controller.stats()
+        return out
+
+
+# -- asyncio HTTP/SSE layer ---------------------------------------------------
+
+
+def _sse(data: dict) -> bytes:
+    return f"data: {json.dumps(data)}\n\n".encode()
+
+
+def _http_response(status: int, body: dict) -> bytes:
+    payload = json.dumps(body).encode()
+    reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+              429: "Too Many Requests",
+              503: "Service Unavailable"}.get(status, "Error")
+    return (f"HTTP/1.1 {status} {reason}\r\n"
+            f"Content-Type: application/json\r\n"
+            f"Content-Length: {len(payload)}\r\n"
+            f"Connection: close\r\n\r\n").encode() + payload
+
+
+_SSE_HEADER = (b"HTTP/1.1 200 OK\r\n"
+               b"Content-Type: text/event-stream\r\n"
+               b"Cache-Control: no-cache\r\n"
+               b"Connection: close\r\n\r\n")
+
+
+class HttpIngress:
+    """Asyncio server pumping one :class:`Gateway`.
+
+    A background task steps the gateway whenever any replica has work
+    and fans fresh tokens out to per-request asyncio queues; request
+    handlers await their queue and write SSE frames.  Everything runs
+    on one event loop — the gateway core is not thread-safe and never
+    needs to be."""
+
+    def __init__(self, gateway: Gateway, *, host: str = "127.0.0.1",
+                 port: int = 0, poll_s: float = 0.005):
+        self.gateway = gateway
+        self.host = host
+        self.port = port
+        self.poll_s = poll_s
+        self._server: asyncio.AbstractServer | None = None
+        self._pump_task: asyncio.Task | None = None
+        self._streams: dict[int, asyncio.Queue] = {}
+        self._sent: dict[int, int] = {}
+        self._stopping = False
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        self._pump_task = asyncio.create_task(self._pump())
+
+    async def stop(self) -> None:
+        self._stopping = True
+        if self._pump_task is not None:
+            await self._pump_task
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    # -- pump ----------------------------------------------------------------
+
+    async def _pump(self) -> None:
+        while not self._stopping:
+            if self.gateway.idle() and not self._streams:
+                await asyncio.sleep(self.poll_s)
+                continue
+            finished = (self.gateway.step()
+                        if not self.gateway.idle() else [])
+            for rid, q in list(self._streams.items()):
+                req = self.gateway._meta.get(rid, {}).get("req")
+                if req is None:
+                    req = next((r for r in finished if r.rid == rid),
+                               None)
+                if req is None:
+                    continue
+                sent = self._sent.get(rid, 0)
+                # a preempted request regenerates from scratch: its
+                # out_tokens shrank below what we already streamed —
+                # greedy recompute reproduces the same ids, so wait
+                # silently until it passes the high-water mark
+                for i in range(sent, len(req.out_tokens)):
+                    q.put_nowait({"i": i, "token": req.out_tokens[i]})
+                self._sent[rid] = max(sent, len(req.out_tokens))
+            for req in finished:
+                q = self._streams.get(req.rid)
+                if q is not None:
+                    total = (req.t_done - req.t_submit
+                             if req.t_done is not None else None)
+                    q.put_nowait({
+                        "done": True, "rid": req.rid,
+                        "usage": {"n_prompt": req.n_prompt,
+                                  "n_new": req.n_generated,
+                                  "cached_tokens": req.cached_tokens,
+                                  "preempted": req.preempted,
+                                  "total_s": total}})
+            await asyncio.sleep(0)
+
+    # -- connection handling -------------------------------------------------
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            await self._handle_inner(reader, writer)
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    async def _handle_inner(self, reader: asyncio.StreamReader,
+                            writer: asyncio.StreamWriter) -> None:
+        request_line = (await reader.readline()).decode("latin-1")
+        parts = request_line.split()
+        if len(parts) < 2:
+            return
+        method, path = parts[0], parts[1]
+        headers: dict[str, str] = {}
+        while True:
+            line = (await reader.readline()).decode("latin-1").strip()
+            if not line:
+                break
+            k, _, v = line.partition(":")
+            headers[k.strip().lower()] = v.strip()
+        if method == "GET" and path == "/healthz":
+            gw = self.gateway
+            writer.write(_http_response(200, {
+                "ok": True, **gw.summary()}))
+            await writer.drain()
+            return
+        if method != "POST" or path != "/v1/generate":
+            writer.write(_http_response(404, {"error": "not found"}))
+            await writer.drain()
+            return
+        n = int(headers.get("content-length", 0))
+        body = await reader.readexactly(n) if n else b"{}"
+        try:
+            payload = json.loads(body)
+            prompt = [int(t) for t in payload["prompt"]]
+            max_new = int(payload.get("max_new_tokens", 16))
+        except (ValueError, KeyError, TypeError) as e:
+            writer.write(_http_response(400, {"error": str(e)}))
+            await writer.drain()
+            return
+        try:
+            req = self.gateway.submit(
+                prompt, max_new,
+                tenant=str(payload.get("tenant", "default")),
+                priority=payload.get("priority", "interactive"),
+                eos_id=payload.get("eos_id"))
+        except (RateLimited, Saturated) as e:
+            writer.write(_http_response(e.status, {"error": str(e)}))
+            await writer.drain()
+            return
+        except (NoHealthyReplica, ValueError) as e:
+            writer.write(_http_response(503, {"error": str(e)}))
+            await writer.drain()
+            return
+        q: asyncio.Queue = asyncio.Queue()
+        self._streams[req.rid] = q
+        self._sent[req.rid] = 0
+        writer.write(_SSE_HEADER)
+        await writer.drain()
+        try:
+            while True:
+                item = await q.get()
+                writer.write(_sse(item))
+                await writer.drain()
+                if item.get("done"):
+                    break
+        finally:
+            self._streams.pop(req.rid, None)
+            self._sent.pop(req.rid, None)
+
+
+async def serve_forever(gateway: Gateway, *, host: str = "127.0.0.1",
+                        port: int = 8080) -> None:
+    """Run the ingress until cancelled (the CLI's --port mode)."""
+    ingress = HttpIngress(gateway, host=host, port=port)
+    await ingress.start()
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await ingress.stop()
+
+
+def sse_generate(host: str, port: int, payload: dict, *,
+                 timeout: float = 60.0) -> list[dict]:
+    """Blocking SSE client (stdlib http.client): POST a generate
+    request, return every event frame.  Bench and tests drive real
+    HTTP through this."""
+    import http.client
+
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    body = json.dumps(payload)
+    conn.request("POST", "/v1/generate", body=body,
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    if resp.status != 200:
+        data = resp.read().decode()
+        conn.close()
+        raise GatewayError(f"HTTP {resp.status}: {data}")
+    events: list[dict] = []
+    buf = ""
+    while True:
+        chunk = resp.read(1024)
+        if not chunk:
+            break
+        buf += chunk.decode()
+        while "\n\n" in buf:
+            frame, _, buf = buf.partition("\n\n")
+            for line in frame.splitlines():
+                if line.startswith("data: "):
+                    events.append(json.loads(line[6:]))
+            if events and events[-1].get("done"):
+                conn.close()
+                return events
+    conn.close()
+    return events

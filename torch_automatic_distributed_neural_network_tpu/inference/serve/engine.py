@@ -392,6 +392,7 @@ class ServeEngine:
                  quant_adapters: bool = False,
                  speculative: int = 0,
                  prefix_cache: bool = False,
+                 prefix_ttl_s: float | None = None,
                  mesh=None,
                  disaggregate: bool = False,
                  rng: jax.Array | None = None,
@@ -456,7 +457,12 @@ class ServeEngine:
         # additionally snapped to prefill-chunk boundaries, so the
         # cache-off run's chunk partition of the recomputed suffix is
         # reproduced exactly (bit-identical tokens either way).
+        self.journal = journal or _journal.get_default()
         self._prefix_cache = None
+        # publish lease: prompts enter the radix index with this TTL
+        # (clock units), so stale preambles age out instead of pinning
+        # leaves until pressure eviction; None = no expiry (legacy)
+        self.prefix_ttl_s = prefix_ttl_s
         match_align = None
         if prefix_cache:
             if prefill_chunk is None:
@@ -465,7 +471,8 @@ class ServeEngine:
                     "(prefill_chunk=None is the legacy single-shot "
                     "path, which cannot resume from a cached prefix)")
             self._prefix_cache = PrefixCache(
-                block_size=block_size, allocator=self.pool.allocator)
+                block_size=block_size, allocator=self.pool.allocator,
+                journal=self.journal)
             match_align = (math.lcm(block_size, self.prefill_chunk)
                            if quant_kv else block_size)
             # pre-compile the hit-seeding reads (fixed shapes compile
@@ -486,7 +493,6 @@ class ServeEngine:
             adapter_pool=self.adapter_pool,
             spec_lookahead=self.speculative,
             prefix_cache=self._prefix_cache, match_align=match_align)
-        self.journal = journal or _journal.get_default()
         self._rng = jax.random.key(0) if rng is None else rng
         self._step_count = 0
         self._occupancy_sum = 0.0
@@ -635,7 +641,8 @@ class ServeEngine:
 
     def submit(self, prompt: list[int], max_new_tokens: int,
                eos_id: int | None = None,
-               adapter: str | None = None) -> Request:
+               adapter: str | None = None,
+               priority: int = 0) -> Request:
         total = len(prompt) + max_new_tokens
         # speculative steps write up to k draft keys past the emitted
         # context — that lookahead must fit the slot's table too
@@ -667,7 +674,7 @@ class ServeEngine:
                 f"{self.pool.num_blocks - 1} allocatable")
         req = Request(prompt=list(map(int, prompt)),
                       max_new_tokens=max_new_tokens, eos_id=eos_id,
-                      adapter=adapter)
+                      adapter=adapter, priority=int(priority))
         self.scheduler.submit(req)
         return req
 
@@ -733,7 +740,7 @@ class ServeEngine:
             n_pub = req.n_prompt // self.pool.block_size
             new = self._prefix_cache.insert(
                 req.prompt[:n_pub * self.pool.block_size],
-                req.blocks[:n_pub])
+                req.blocks[:n_pub], ttl_s=self.prefix_ttl_s)
             if new and self.journal is not None:
                 self.journal.event(
                     "serve.prefix", kind="publish", rid=req.rid,

@@ -65,15 +65,20 @@ def block_hashes(tokens: list[int], block_size: int) -> list[str]:
 
 
 class _Node:
-    __slots__ = ("key", "block", "parent", "children", "last_hit")
+    __slots__ = ("key", "block", "parent", "children", "last_hit",
+                 "expires_at")
 
     def __init__(self, key: str, block: int, parent: "_Node | None",
-                 last_hit: float):
+                 last_hit: float, expires_at: float | None = None):
         self.key = key
         self.block = block
         self.parent = parent
         self.children: dict[str, _Node] = {}
         self.last_hit = last_hit
+        # lease expiry (clock units); None = pinned until evicted by
+        # pressure.  An expired node is dead to ``match`` immediately
+        # and physically reclaimed lazily (match/evict sweeps).
+        self.expires_at = expires_at
 
 
 class PrefixCache:
@@ -84,18 +89,27 @@ class PrefixCache:
     """
 
     def __init__(self, *, block_size: int, allocator: BlockAllocator,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 journal=None):
         self.block_size = int(block_size)
         self.allocator = allocator
         self.clock = clock
+        # optional obs.journal.Journal: TTL reclamation emits
+        # ``serve.prefix kind=expire`` events through it
+        self.journal = journal
         self._root = _Node("", NULL_BLOCK, None, 0.0)
         self._nodes: dict[str, _Node] = {}
+        # earliest lease expiry across the index, or None when no node
+        # carries a TTL — lets the expiry sweep short-circuit on the
+        # (default) TTL-free hot path
+        self._next_expiry: float | None = None
         # lifetime counters (report/bench surface these)
         self.queries = 0
         self.hit_requests = 0
         self.hit_tokens = 0
         self.inserted_blocks = 0
         self.evicted_blocks = 0
+        self.expired_blocks = 0
 
     # -- introspection -------------------------------------------------------
 
@@ -149,6 +163,7 @@ class PrefixCache:
         limit = len(tokens) if max_tokens is None else max_tokens
         if keys is None:
             keys = block_hashes(tokens, self.block_size)
+        self.expire()
         blocks: list[int] = []
         node = self._root
         now = self.clock()
@@ -157,6 +172,12 @@ class PrefixCache:
                 break
             child = node.children.get(key)
             if child is None:
+                break
+            if child.expires_at is not None and now >= child.expires_at:
+                # lease lapsed but the block is still pinned by a live
+                # table (the sweep could not drop it): dead to matching
+                # regardless — stale content must not extend its own
+                # residency by being re-hit
                 break
             child.last_hit = now
             blocks.append(child.block)
@@ -170,28 +191,44 @@ class PrefixCache:
             self.hit_requests += 1
             self.hit_tokens += n_cached_tokens
 
-    def insert(self, tokens: list[int], blocks: list[int]) -> int:
+    def insert(self, tokens: list[int], blocks: list[int], *,
+               ttl_s: float | None = None) -> int:
         """Publish a prefill's full prompt blocks; returns how many new
         nodes were indexed.  ``blocks[i]`` must hold the KV of tokens
         ``[i*bs, (i+1)*bs)`` (the caller passes a committed table
         prefix).  Prefixes already indexed are left as-is — the first
         publisher wins, even if this request recomputed the same
         content into different blocks — and each NEWLY indexed block
-        gains one allocator reference owned by the index."""
+        gains one allocator reference owned by the index.
+
+        ``ttl_s`` bounds residency: nodes published with a TTL stop
+        matching ``ttl_s`` clock units after their LAST publish and are
+        reclaimed lazily (the match/evict expiry sweeps) — one tenant's
+        stale system prompts cannot pin index leaves forever.  A
+        re-publish of already-indexed content renews its lease (the
+        content is demonstrably still live traffic)."""
         new = 0
         node = self._root
         now = self.clock()
+        expires = None if ttl_s is None else now + float(ttl_s)
         for i, key in enumerate(block_hashes(tokens, self.block_size)):
             if i >= len(blocks):
                 break
             child = node.children.get(key)
             if child is None:
-                child = _Node(key, blocks[i], node, now)
+                child = _Node(key, blocks[i], node, now, expires)
                 node.children[key] = child
                 self._nodes[key] = child
                 self.allocator.ref(blocks[i])
                 new += 1
+            elif ttl_s is not None:
+                child.last_hit = now
+                if child.expires_at is not None:
+                    child.expires_at = max(child.expires_at, expires)
             node = child
+        if expires is not None and new:
+            if self._next_expiry is None or expires < self._next_expiry:
+                self._next_expiry = expires
         self.inserted_blocks += new
         return new
 
@@ -202,13 +239,50 @@ class PrefixCache:
                 if not n.children
                 and self.allocator.refcount(n.block) == 1]
 
-    def evict(self, n: int) -> int:
-        """Reclaim up to ``n`` blocks, coldest (least-recent hit)
-        unreferenced leaves first; returns how many were freed.  Runs
-        under allocator pressure BEFORE any live slot is preempted —
-        dropping cold reusable KV is strictly cheaper than recomputing
-        a live request."""
+    def expire(self) -> int:
+        """Reclaim every expired-lease block that is droppable right
+        now (unreferenced leaf, walking up exposed parents); returns
+        how many were freed.  Lazy: runs at the top of ``match`` and
+        ``evict``, never on a timer, and short-circuits to a no-op
+        until the earliest lease in the index has actually lapsed.
+        Expired nodes still pinned by a live table stay resident (the
+        pool reference discipline owns them) but never match; they are
+        picked up by a later sweep once released."""
+        now = self.clock()
+        if self._next_expiry is None or now < self._next_expiry:
+            return 0
         freed = 0
+        while True:
+            victims = [node for node in self._evictable_leaves()
+                       if node.expires_at is not None
+                       and now >= node.expires_at]
+            if not victims:
+                break
+            for node in victims:
+                self._drop(node)
+            freed += len(victims)
+        self._next_expiry = min(
+            (node.expires_at for node in self._nodes.values()
+             if node.expires_at is not None), default=None)
+        if freed:
+            self.expired_blocks += freed
+            if self.journal is not None:
+                self.journal.event("serve.prefix", kind="expire",
+                                   n_blocks=freed,
+                                   index_blocks=len(self._nodes))
+        return freed
+
+    def evict(self, n: int) -> int:
+        """Reclaim up to ``n`` blocks, expired leases first, then the
+        coldest (least-recent hit) unreferenced leaves; returns how
+        many were freed.  Runs under allocator pressure BEFORE any live
+        slot is preempted — dropping cold reusable KV is strictly
+        cheaper than recomputing a live request."""
+        freed = 0
+        expired = self.expire()
+        if expired >= n:
+            return expired
+        n -= expired
         while freed < n:
             leaves = self._evictable_leaves()
             if not leaves:
@@ -217,7 +291,7 @@ class PrefixCache:
             self._drop(victim)
             freed += 1
         self.evicted_blocks += freed
-        return freed
+        return expired + freed
 
     def _drop(self, node: _Node) -> None:
         assert not node.children, "evicting an interior radix node"

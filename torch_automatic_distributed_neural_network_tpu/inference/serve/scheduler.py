@@ -24,7 +24,8 @@ slot only if the pool can cover its blocks under the chosen policy:
   its blocks are freed and the request is re-queued in FIFO submission
   order to be recomputed from scratch later (recompute-style — no
   cache swap to host).  ``Request.preempted`` counts the restarts.
-  Requeue position is by ``(t_submit, rid)``, NOT the queue front:
+  Requeue position is by ``(priority, t_submit, rid)``, NOT the queue
+  front:
   front-requeueing let a young victim jump ahead of earlier-submitted
   requests still waiting for their first admission, inverting FIFO
   fairness exactly when the pool is most contended.
@@ -174,6 +175,12 @@ class Request:
     # states)
     adapter: str | None = None
     adapter_idx: int = IDENTITY_ADAPTER
+    # admission class: lower value is more urgent (the gateway maps
+    # "interactive" -> 0, "batch" -> 1).  Queue order is
+    # ``(priority, t_submit, rid)`` — strict FIFO WITHIN a class, and
+    # the default 0 for every request degenerates to the legacy pure
+    # FIFO order
+    priority: int = 0
 
     # lifecycle: queued -> [prefilling ->] running -> done (preemption
     # loops back to queued; "prefilling" only under the engine's
@@ -371,9 +378,21 @@ class Scheduler:
 
     # -- admission / eviction ------------------------------------------------
 
+    @staticmethod
+    def _queue_key(req: Request) -> tuple[int, float, int]:
+        return (req.priority, req.t_submit, req.rid)
+
     def submit(self, req: Request) -> None:
         req.state = "queued"
-        self.queue.append(req)
+        # priority-ordered insert: ahead of every queued request in a
+        # LOWER class (higher priority value), behind every peer in its
+        # own class — FIFO within a class.  With the default priority 0
+        # everywhere this is a plain append.
+        if not self.queue or self._queue_key(self.queue[-1]) < \
+                self._queue_key(req):
+            self.queue.append(req)
+        else:
+            self._requeue_fifo(req)
 
     def _blocks_at_admission(self, req: Request) -> int:
         return blocks_at_admission(
@@ -404,13 +423,14 @@ class Scheduler:
         req.adapter_idx = IDENTITY_ADAPTER
 
     def _requeue_fifo(self, req: Request) -> None:
-        """Re-insert by ``(t_submit, rid)``: admission order is FIFO by
-        submission, so a bounced request rejoins exactly where its
-        arrival puts it — ahead of later submissions, never ahead of
-        earlier ones still waiting."""
-        key = (req.t_submit, req.rid)
+        """Re-insert by ``(priority, t_submit, rid)``: admission order
+        is FIFO by submission within a priority class, so a bounced
+        request rejoins exactly where its class and arrival put it —
+        ahead of later submissions in its class and of any lower class,
+        never ahead of an earlier same-class request still waiting."""
+        key = self._queue_key(req)
         idx = next((i for i, r in enumerate(self.queue)
-                    if (r.t_submit, r.rid) > key), len(self.queue))
+                    if self._queue_key(r) > key), len(self.queue))
         self.queue.insert(idx, req)
 
     def requeue(self, slot: int) -> Request:

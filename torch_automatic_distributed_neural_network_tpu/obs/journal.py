@@ -62,14 +62,23 @@ class Journal:
 
     def __init__(self, path: str | None = None, *,
                  host0_only: bool = True, meta: dict | None = None,
-                 max_bytes: int | None = None):
+                 max_bytes: int | None = None,
+                 clock=time.monotonic):
         self.path = path
         self.enabled = (not host0_only) or _process_index() == 0
-        self._t0 = time.monotonic()
+        # ``t`` stamps come from here: inject a virtual clock and every
+        # record's event-time is replayable (the gateway's chaos test
+        # journals byte-identical sequences across runs this way)
+        self._clock = clock
+        self._t0 = clock()
         self._depth = 0
         self._file: IO | None = None
         self.records: list[dict] = []  # in-memory sink when path is None
         self.counts: dict[str, int] = {}
+        # live taps: called with each record as it is written (the
+        # gateway's fleet controller folds windows from here without
+        # re-reading the file)
+        self._subscribers: list = []
         if max_bytes is None:
             try:
                 max_bytes = int(
@@ -101,6 +110,15 @@ class Journal:
                 self._rotate()
         else:
             self.records.append(rec)
+        for fn in self._subscribers:
+            fn(rec)
+
+    def subscribe(self, fn) -> None:
+        """Register a live tap: ``fn(rec)`` runs for every record this
+        journal writes, file-backed or in-memory — the streaming
+        consumer path (LiveAggregator in-process) that doesn't re-read
+        the file it is itself producing."""
+        self._subscribers.append(fn)
 
     def _rotate(self) -> None:
         """Move the full file to ``<path>.1`` (replacing any previous
@@ -130,7 +148,7 @@ class Journal:
         if not self.enabled:
             return None
         rec = {"kind": "event", "name": name,
-               "t": time.monotonic() - self._t0, "wall": time.time(),
+               "t": self._clock() - self._t0, "wall": time.time(),
                "depth": self._depth, **fields}
         self._write(rec)
         return rec
@@ -144,7 +162,7 @@ class Journal:
         if not self.enabled:
             yield rec
             return
-        t_start = time.monotonic()
+        t_start = self._clock()
         rec["t"] = t_start - self._t0
         rec["wall"] = time.time()
         rec["depth"] = self._depth
@@ -156,7 +174,7 @@ class Journal:
             raise
         finally:
             self._depth -= 1
-            rec["dur_s"] = time.monotonic() - t_start
+            rec["dur_s"] = self._clock() - t_start
             self._write(rec)
 
     def named(self, prefix: str) -> list[dict]:
@@ -237,8 +255,21 @@ class Journal:
         after ``idle_timeout`` seconds with no new bytes (None = follow
         forever).  ``sleep`` is injectable so tests can drive the tail
         loop without real waiting.
+
+        The path may not exist yet — a monitor is routinely started
+        before the engine's first event (the gateway does exactly
+        this): creation is polled for under the same ``idle_timeout``
+        budget instead of raising.
         """
         buf = ""
+        idle = 0.0
+        while not os.path.exists(path):
+            if stop is not None and stop():
+                return
+            if idle_timeout is not None and idle >= idle_timeout:
+                return
+            sleep(poll_s)
+            idle += poll_s
         idle = 0.0
         with open(path) as f:
             while True:
@@ -285,7 +316,9 @@ class _NullJournal(Journal):
         self._file = None
         self.records = []
         self.counts = {}
+        self._subscribers = []
         self._depth = 0
+        self._clock = time.monotonic
         self._t0 = time.monotonic()
 
 

@@ -156,6 +156,10 @@ class _Window:
         self.accepted = 0
 
     def empty(self) -> bool:
+        # a window holding serve.step events but zero request_done is
+        # NOT empty: it must be emitted with explicit zero throughput
+        # (an engine grinding through prefills or a stalled queue is a
+        # real zero-tok/s observation, unlike an idle engine)
         return not (self.n_done or self.n_steps or self.preemptions)
 
 
@@ -192,7 +196,7 @@ class LiveAggregator:
         self.itl_all = LatencySketch()
         self.latency_all = LatencySketch()
         self.totals = {"n_done": 0, "new_tokens": 0, "preemptions": 0,
-                       "n_steps": 0}
+                       "n_steps": 0, "occupancy_sum": 0.0}
 
     # -- folding -------------------------------------------------------------
 
@@ -254,7 +258,10 @@ class LiveAggregator:
         if w is None or w.empty():
             return None
         # pre-r06 journals carry no per-step token counts; fall back to
-        # completion-time attribution (lumpier, still correct in total)
+        # completion-time attribution (lumpier, still correct in total).
+        # Step-only windows (zero completions) emit tokens == 0 — an
+        # explicit zero-throughput observation, never a skipped window
+        # and never a divide against an empty accumulator.
         tokens = (w.new_tokens if w.steps_with_tokens else w.done_tokens)
         out = {
             "window": w.key,
@@ -289,6 +296,7 @@ class LiveAggregator:
         self.totals["new_tokens"] += tokens
         self.totals["preemptions"] += w.preemptions
         self.totals["n_steps"] += w.n_steps
+        self.totals["occupancy_sum"] += w.occupancy_sum
         return out
 
     def flush(self) -> dict | None:
@@ -325,8 +333,15 @@ class LiveAggregator:
             "n_steps": self.totals["n_steps"],
             "preemptions": self.totals["preemptions"],
             "span_s": span,
+            # guarded divides: a run of step-only windows has tokens
+            # and steps but possibly zero completions — the roll-up
+            # must report explicit zeros, and an all-done-only journal
+            # (no serve.step records) must not divide by zero steps
             "tok_s": (self.totals["new_tokens"] / span
                       if span else None),
+            "occupancy": (self.totals["occupancy_sum"]
+                          / self.totals["n_steps"]
+                          if self.totals["n_steps"] else None),
             "ttft_p50_s": self.ttft_all.percentile(0.50),
             "ttft_p99_s": self.ttft_all.percentile(0.99),
             "itl_p50_s": self.itl_all.percentile(0.50),

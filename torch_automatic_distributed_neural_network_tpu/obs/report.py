@@ -490,6 +490,39 @@ def generate(target: str, metrics_path: str | None = None) -> dict:
                     for e in recovers]),
                 key=lambda i: (i.get("window_start_s") or 0.0)),
         }
+    # gateway fleet events (inference/gateway): ingress admission,
+    # replan decisions, and elastic resizes from the closed-loop
+    # autoscaler
+    greqs = [e for e in events if e.get("name") == "gateway.request"]
+    grejects = [e for e in events if e.get("name") == "gateway.reject"]
+    greplans = [e for e in events if e.get("name") == "gateway.replan"]
+    gscales = [e for e in events if e.get("name") == "gateway.scale"]
+    if greqs or grejects or greplans or gscales:
+        gw: dict[str, Any] = {
+            "requests": len(greqs),
+            "rejected": len(grejects),
+            "rejected_rate_limit": sum(
+                1 for e in grejects if e.get("kind") == "rate_limit"),
+            "rejected_backpressure": sum(
+                1 for e in grejects if e.get("kind") == "backpressure"),
+            "replans": [
+                {"t": e.get("t"), "reason": e.get("reason"),
+                 "current": e.get("current"), "chosen": e.get("chosen"),
+                 "rate_per_s": e.get("rate_per_s")}
+                for e in greplans],
+            "scales": [
+                {"t": e.get("t"), "kind": e.get("kind"),
+                 "replica": e.get("replica"),
+                 "reason": e.get("reason"),
+                 "n_replicas": e.get("n_replicas"),
+                 "requeued": e.get("requeued")}
+                for e in gscales],
+        }
+        if gscales:
+            final = [e.get("n_replicas") for e in gscales
+                     if e.get("n_replicas") is not None]
+            gw["final_replicas"] = final[-1] if final else None
+        report["gateway"] = gw
     # planner drift (obs/slo_monitor.drift_check): measured throughput
     # left the simulate prediction's 2x band
     drifts = [e for e in events if e.get("name") == "simulate.drift"]
@@ -946,6 +979,33 @@ def format_report(report: dict) -> str:
                     "  recovered " + where
                     + (f" after {inc['ok_windows']} clean window(s)"
                        if inc.get("ok_windows") is not None else ""))
+    gw = report.get("gateway")
+    if gw:
+        rej = gw.get("rejected", 0)
+        lines.append(
+            f"gateway: {gw.get('requests', 0)} request(s) accepted, "
+            f"{rej} rejected"
+            + (f" ({gw.get('rejected_rate_limit', 0)} rate-limit, "
+               f"{gw.get('rejected_backpressure', 0)} backpressure)"
+               if rej else ""))
+        for rp in gw.get("replans", ()):
+            lines.append(
+                f"  replan t={(rp.get('t') or 0.0):7.2f}s "
+                f"[{rp.get('reason')}]: {rp.get('current')} -> "
+                f"{rp.get('chosen')} replica(s) at "
+                f"{(rp.get('rate_per_s') or 0):.0f} req/s")
+        for sc in gw.get("scales", ()):
+            what = (f"scale-{sc.get('kind')}" if sc.get("kind")
+                    else "scale")
+            extra = (f", {sc['requeued']} request(s) requeued"
+                     if sc.get("requeued") is not None else "")
+            lines.append(
+                f"  {what} t={(sc.get('t') or 0.0):7.2f}s: "
+                f"{sc.get('replica')} -> fleet of "
+                f"{sc.get('n_replicas')}{extra}")
+        if gw.get("final_replicas") is not None:
+            lines.append(
+                f"  final fleet: {gw['final_replicas']} replica(s)")
     drift = report.get("drift")
     if drift:
         for d in drift:
