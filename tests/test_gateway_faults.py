@@ -1,0 +1,343 @@
+"""Fleet fault-tolerance tests (inference/gateway/fault + ingress):
+heartbeat failover with exactly-once token parity, hedge
+first-writer-wins cancellation, circuit-breaker state machine, degrade
+shed-order determinism, Retry-After estimates, the fleet chaos gate,
+and the gateway doctor post-mortem."""
+
+import json
+
+import pytest
+
+from torch_automatic_distributed_neural_network_tpu import cli
+from torch_automatic_distributed_neural_network_tpu.inference.gateway import (
+    BreakerPolicy,
+    CircuitBreaker,
+    Gateway,
+    HedgePolicy,
+    RateLimited,
+    Saturated,
+    SimReplica,
+    fleet_chaos,
+)
+from torch_automatic_distributed_neural_network_tpu.inference.gateway \
+    .doctor import format_gateway_doctor, gateway_doctor
+from torch_automatic_distributed_neural_network_tpu.inference.gateway \
+    .fault import degrade_effects, shed_threshold
+from torch_automatic_distributed_neural_network_tpu.inference.gateway \
+    .ingress import _retry_headers
+from torch_automatic_distributed_neural_network_tpu.obs.journal import (
+    Journal,
+)
+
+
+def _fleet(n=2, *, journal=None, clock=None, **kw):
+    clock = clock if clock is not None else [0.0]
+    reps = [SimReplica(f"replica{i}", n_slots=4, block_size=8,
+                       max_len=256, prefill_chunk=8,
+                       clock=lambda: clock[0], journal=journal, **kw)
+            for i in range(n)]
+    return reps, clock
+
+
+def _drive(gw, clock, *, tick=5e-3, max_steps=20_000):
+    for _ in range(max_steps):
+        if gw.idle() and not gw._meta:
+            return
+        gw.step()
+        clock[0] += tick
+    raise AssertionError("gateway did not drain")
+
+
+# -- failover token parity ----------------------------------------------------
+
+
+def _run_kill_scenario(kill: bool):
+    """Same 12 requests on 2 replicas; optionally kill replica1 after
+    decode has started.  Returns {rid: delivered tokens}."""
+    jnl = Journal(None, host0_only=False)
+    clock = [0.0]
+    reps, _ = _fleet(2, journal=jnl, clock=clock)
+    gw = Gateway(reps, journal=jnl, clock=lambda: clock[0],
+                 heartbeat_s=0.05, queue_limit=1000)
+    rids = []
+    for i in range(12):
+        # distinct tails force both replicas into play (least-loaded)
+        req = gw.submit([1] * 16 + [50 + i] * 8, 8, eos_id=0,
+                        n_decode=6, tenant=f"t{i % 3}")
+        rids.append(req.rid)
+    # step until replica1 is mid-decode (some slot has emitted tokens)
+    for _ in range(200):
+        gw.step()
+        clock[0] += 5e-3
+        if any(r is not None and len(r.out_tokens) >= 2
+               for r in reps[1].scheduler.slots):
+            break
+    else:
+        raise AssertionError("replica1 never reached mid-decode")
+    if kill:
+        reps[1].kill()
+    _drive(gw, clock)
+    assert gw.n_done == len(rids)
+    return {rid: gw.delivered(rid) for rid in rids}, gw
+
+
+def test_failover_token_parity_kill_mid_decode():
+    fault_free, _ = _run_kill_scenario(kill=False)
+    faulted, gw = _run_kill_scenario(kill=True)
+    # the kill really failed something over...
+    assert gw.n_failovers == 1
+    # ...and every stream is bitwise-identical to the fault-free run:
+    # no dropped tokens, no duplicates, same ids in the same order
+    assert faulted == fault_free
+    assert all(s[-1] == 0 and len(s) == 6 for s in faulted.values())
+
+
+def test_failover_journals_salvaged_rids():
+    _, gw = _run_kill_scenario(kill=True)
+    evs = [r for r in gw.journal.records
+           if r.get("name") == "gateway.failover"]
+    assert evs and evs[0]["reason"] == "heartbeat_expired"
+    assert evs[0]["n_requeued"] == len(evs[0]["rids"]) > 0
+    # the dead replica's affinity claims were forgotten: the shared
+    # prefix re-homes on the survivor instead of chasing the corpse
+    assert all(owner != "replica1"
+               for owner in gw.router._owner.values())
+
+
+def test_router_decays_dead_owner_claims():
+    jnl = Journal(None, host0_only=False)
+    clock = [0.0]
+    reps, _ = _fleet(2, journal=jnl, clock=clock)
+    gw = Gateway(reps, journal=jnl, clock=lambda: clock[0])
+    prompt = [7] * 32
+    first = gw.router.route(prompt)
+    assert gw.router.route(prompt) is first  # affinity sticks
+    first.alive = False  # dies WITHOUT a failover forgetting claims
+    other = gw.router.route(prompt)
+    assert other is not first
+    # the dead owner's claims were overwritten toward the survivor
+    assert gw.router.n_decayed > 0
+    assert all(owner == other.name
+               for owner in gw.router._owner.values())
+
+
+# -- hedging ------------------------------------------------------------------
+
+
+def test_hedge_first_writer_wins_and_cancels_loser():
+    jnl = Journal(None, host0_only=False)
+    clock = [0.0]
+    reps, _ = _fleet(2, journal=jnl, clock=clock)
+    gw = Gateway(reps, journal=jnl, clock=lambda: clock[0],
+                 hedge=HedgePolicy(after_s=0.1,
+                                   max_hedges_per_request=1))
+    req = gw.submit([1] * 16, 8, eos_id=0, n_decode=6)
+    primary = gw._meta[req.rid]["replica"]
+    primary.stalled = True  # heartbeats, never advances
+    _drive(gw, clock)
+    assert gw.n_hedges == 1 and gw.n_hedge_wins == 1
+    evs = [r for r in jnl.records if r.get("name") == "gateway.hedge"]
+    assert [e["kind"] for e in evs] == ["dispatch", "win"]
+    assert evs[1]["winner"] == "hedge"
+    # the losing copy was cancelled off the stalled replica without a
+    # completion span: its scheduler is empty, no duplicate done event
+    assert primary.scheduler.idle()
+    dones = [r for r in jnl.records
+             if r.get("name") == "serve.request_done"]
+    assert [d["rid"] for d in dones] == [req.rid]
+    assert gw.delivered(req.rid) == [1] * 5 + [0]
+
+
+def test_hedge_respects_max_hedges_and_needs_second_replica():
+    jnl = Journal(None, host0_only=False)
+    clock = [0.0]
+    reps, _ = _fleet(1, journal=jnl, clock=clock)
+    gw = Gateway(reps, journal=jnl, clock=lambda: clock[0],
+                 hedge=HedgePolicy(after_s=0.05))
+    req = gw.submit([1] * 16, 4, eos_id=0, n_decode=3)
+    reps[0].stalled = True
+    for _ in range(100):
+        gw.step()
+        clock[0] += 5e-3
+    # nowhere to hedge to: a single-replica fleet never hedges
+    assert gw.n_hedges == 0 and req.rid in gw._meta
+
+
+# -- circuit breaker ----------------------------------------------------------
+
+
+def test_breaker_open_half_open_close_cycle():
+    clock = [0.0]
+    jnl = Journal(None, host0_only=False)
+    br = CircuitBreaker(
+        "r0", BreakerPolicy(window_s=1.0, min_observations=4,
+                            failure_rate=0.5, open_s=0.5, clean_s=0.2),
+        clock=lambda: clock[0], journal=jnl)
+    assert br.state == "closed" and br.allow()
+    for _ in range(4):
+        br.observe(False)
+        clock[0] += 0.01
+    assert br.state == "open" and not br.allow()
+    # traffic cannot close an open breaker; only time half-opens it
+    br.observe(True)
+    assert br.state == "open"
+    clock[0] += 0.5
+    br.tick()
+    assert br.state == "half_open" and br.allow()
+    # a failure during probation re-opens immediately
+    br.observe(False)
+    assert br.state == "open"
+    clock[0] += 0.5
+    br.tick()
+    assert br.state == "half_open"
+    br.observe(True)
+    clock[0] += 0.25
+    br.tick()
+    assert br.state == "closed"
+    assert br.n_opens == 2
+    states = [(r["from"], r["to"]) for r in jnl.records
+              if r.get("name") == "gateway.breaker"]
+    assert states == [("closed", "open"), ("open", "half_open"),
+                      ("half_open", "open"), ("open", "half_open"),
+                      ("half_open", "closed")]
+
+
+def test_breaker_gates_routing_of_stalled_replica():
+    jnl = Journal(None, host0_only=False)
+    clock = [0.0]
+    reps, _ = _fleet(2, journal=jnl, clock=clock)
+    gw = Gateway(reps, journal=jnl, clock=lambda: clock[0],
+                 breaker=BreakerPolicy(window_s=0.1,
+                                       min_observations=5,
+                                       failure_rate=0.5,
+                                       open_s=10.0, clean_s=0.1))
+    # load replica1 so the breaker has observations, then stall it
+    victim = reps[1]
+    victim.submit([9] * 16, 4, eos_id=0, n_decode=3)
+    victim.stalled = True
+    for _ in range(20):
+        gw.step()
+        clock[0] += 5e-3
+    assert gw._breakers["replica1"].state == "open"
+    # new traffic only ever routes to the healthy replica now
+    for i in range(6):
+        req = gw.submit([30 + i] * 24, 2, eos_id=0, n_decode=2)
+        assert gw._meta[req.rid]["replica"].name == "replica0"
+
+
+# -- degraded modes -----------------------------------------------------------
+
+
+def test_shed_order_is_deterministic_lowest_class_first():
+    classes = [0, 1]
+    # level 0/1 shed nothing; level 2+ sheds batch (1), never
+    # interactive (0) — the shed set only ever grows with level
+    assert shed_threshold(0, classes) is None
+    assert shed_threshold(1, classes) is None
+    assert shed_threshold(2, classes) == 1
+    assert shed_threshold(3, classes) == 1
+    wide = [0, 1, 2, 3]
+    assert degrade_effects(2, wide)["shed_classes"] == [3]
+    assert degrade_effects(3, wide)["shed_classes"] == [2, 3]
+    # clamped at the ladder top; class 0 always survives
+    assert 0 not in degrade_effects(9, wide)["shed_classes"]
+
+
+def test_gateway_degrade_sheds_batch_and_restores():
+    jnl = Journal(None, host0_only=False)
+    clock = [0.0]
+    reps, _ = _fleet(1, journal=jnl, clock=clock)
+    gw = Gateway(reps, journal=jnl, clock=lambda: clock[0],
+                 queue_limit=8)
+    gw.set_degrade(2, reason="test")
+    assert gw.degrade_level == 2 and not gw.speculation_enabled
+    with pytest.raises(Saturated) as ei:
+        gw.submit([1] * 16, 2, priority="batch")
+    assert ei.value.retry_after is not None
+    gw.submit([1] * 16, 2, priority="interactive", n_decode=2)
+    gw.set_degrade(0, reason="recovered")
+    gw.submit([2] * 16, 2, priority="batch", n_decode=2)
+    names = [r["name"] for r in jnl.records
+             if r.get("name", "").startswith("gateway.")]
+    assert "gateway.degrade" in names and "gateway.restore" in names
+    rejects = [r for r in jnl.records
+               if r.get("name") == "gateway.reject"]
+    assert [r["kind"] for r in rejects] == ["degraded"]
+
+
+# -- Retry-After --------------------------------------------------------------
+
+
+def test_retry_after_from_token_bucket_and_queue():
+    jnl = Journal(None, host0_only=False)
+    clock = [0.0]
+    reps, _ = _fleet(1, journal=jnl, clock=clock)
+    gw = Gateway(reps, journal=jnl, clock=lambda: clock[0],
+                 rate_limit_per_s=2.0, burst=1, queue_limit=1)
+    gw.submit([1] * 16, 4, n_decode=4, tenant="a")
+    with pytest.raises(RateLimited) as ei:
+        gw.submit([1] * 16, 4, tenant="a")
+    # bucket refills at 2/s from empty: next token in ~0.5s
+    assert ei.value.retry_after == pytest.approx(0.5)
+    assert _retry_headers(ei.value) == {"Retry-After": "1"}
+    clock[0] += 10.0
+    with pytest.raises(Saturated) as ei:
+        gw.submit([1] * 16, 4, tenant="a")
+    assert ei.value.retry_after >= 0.05
+    rejects = [r for r in jnl.records
+               if r.get("name") == "gateway.reject"]
+    assert all(r.get("retry_after") is not None for r in rejects)
+
+
+# -- fleet chaos gate + doctor ------------------------------------------------
+
+
+def test_fleet_chaos_gate_and_doctor(tmp_path, capsys):
+    path = str(tmp_path / "journal.jsonl")
+    out = fleet_chaos(journal_path=path, seed=0, n_replicas=4)
+    assert out["ok"], out
+    assert out["deterministic"] and out["stream_parity"]
+    assert out["all_completed"] and out["killed_inflight"]
+    assert out["failovers"] >= 1 and out["hedges"] >= 1
+    # the doctor reconstructs the same story from the journal alone
+    doc = gateway_doctor(str(tmp_path))
+    assert doc["ok"] and doc["lost_rids"] == []
+    assert doc["accepted"] == out["accepted"]
+    assert len(doc["failovers"]) == out["failovers"]
+    assert doc["hedges"]["dispatched"] == out["hedges"]
+    assert doc["culprit"] is not None
+    text = format_gateway_doctor(doc)
+    assert "failover" in text and "verdict: OK" in text
+    # CLI twin: tadnn doctor --gateway-dir exits 0 on a healthy fleet
+    rc = cli.main(["doctor", "--gateway-dir", path, "--json"])
+    assert rc == 0
+    doc2 = json.loads(capsys.readouterr().out)
+    assert doc2["ok"] is True
+
+
+def test_gateway_chaos_cli_exit_codes(tmp_path, capsys):
+    path = str(tmp_path / "chaos.jsonl")
+    rc = cli.main(["gateway", "--chaos", "--seed", "1",
+                   "--journal", path])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["ok"] and out["seed"] == 1
+    # a different seed still holds: the gate is seed-parametric, not
+    # tuned to one lucky schedule
+    assert out["failovers"] >= 1
+
+
+def test_fault_report_section_renders(tmp_path):
+    from torch_automatic_distributed_neural_network_tpu.obs import (
+        report as obs_report,
+    )
+
+    path = str(tmp_path / "journal.jsonl")
+    fleet_chaos(journal_path=path, seed=0, n_replicas=4)
+    rep = obs_report.generate(path)
+    gw = rep["gateway"]
+    assert gw["failovers"] and gw["hedges_dispatched"] >= 1
+    assert gw["breaker_opens"] >= 1
+    text = obs_report.format_report(rep)
+    assert "failover" in text and "hedges:" in text
+    assert "circuit breaker" in text

@@ -664,7 +664,10 @@ def cmd_doctor(args: argparse.Namespace) -> int:
 
     ``--launch-dir`` switches to launch supervision health (training/
     launch.py): per-host last-seen heartbeats, restart-budget
-    consumption, and which host broke the cohort."""
+    consumption, and which host broke the cohort.  ``--gateway-dir``
+    is the serving twin: a fleet post-mortem from a gateway journal —
+    per-replica heartbeats, failovers, hedge record, breaker/degrade
+    history, and which replica broke the cohort."""
     from .training import resilience
 
     if getattr(args, "launch_dir", None):
@@ -676,8 +679,18 @@ def cmd_doctor(args: argparse.Namespace) -> int:
         else:
             print(launch_mod.format_launch_doctor(doc))
         return 1 if doc.get("ok") is False else 0
+    if getattr(args, "gateway_dir", None):
+        from .inference.gateway import doctor as gw_doctor
+
+        doc = gw_doctor.gateway_doctor(args.gateway_dir)
+        if args.json:
+            print(json.dumps(doc))
+        else:
+            print(gw_doctor.format_gateway_doctor(doc))
+        return 1 if doc.get("ok") is False else 0
     if not args.directory:
-        print("doctor: a checkpoint directory or --launch-dir is required",
+        print("doctor: a checkpoint directory, --launch-dir or "
+              "--gateway-dir is required",
               file=sys.stderr)
         return 2
     from .training import shards
@@ -1150,12 +1163,31 @@ def cmd_gateway(args: argparse.Namespace) -> int:
 
     ``--smoke`` runs the virtual-clock chaos scenario twice (traffic
     flip → SLO breach → replan → scale-out → recover) and checks the
-    two journals are byte-identical — the CI gate.  ``--port`` starts
-    a real asyncio HTTP/SSE server over ``--replicas`` tiny engines
-    (the ``tadnn serve --smoke`` model) for interactive use.
+    two journals are byte-identical — the CI gate.  ``--chaos`` runs
+    the FLEET fault scenario (seeded replica kill/stall/slow) and
+    passes only if every accepted request completes with a token
+    stream bitwise-identical to a fault-free replay, deterministically
+    across two runs.  ``--port`` starts a real asyncio HTTP/SSE server
+    over ``--replicas`` tiny engines (the ``tadnn serve --smoke``
+    model) for interactive use.
     """
-    from .inference.gateway import chaos_smoke
+    from .inference.gateway import chaos_smoke, fleet_chaos
 
+    if getattr(args, "chaos", False):
+        out = fleet_chaos(
+            journal_path=args.journal,
+            seed=args.seed,
+            n_replicas=max(4, args.replicas))
+        print(json.dumps(out))
+        if not out["ok"]:
+            for flag in ("deterministic", "stream_parity",
+                         "all_completed", "killed_inflight",
+                         "baseline_complete"):
+                if not out[flag]:
+                    print(f"gateway chaos: {flag} check failed",
+                          file=sys.stderr)
+            return 1
+        return 0
     if args.smoke:
         out = chaos_smoke(
             journal_path=args.journal,
@@ -1173,7 +1205,8 @@ def cmd_gateway(args: argparse.Namespace) -> int:
             return 1
         return 0
     if not args.port:
-        print("tadnn gateway needs --smoke or --port", file=sys.stderr)
+        print("tadnn gateway needs --smoke, --chaos or --port",
+              file=sys.stderr)
         return 2
 
     import asyncio
@@ -1890,6 +1923,12 @@ def main(argv: list[str] | None = None) -> int:
                    help="report launch supervision health instead "
                         "(per-host heartbeats, restart budget, which "
                         "host broke the cohort)")
+    p.add_argument("--gateway-dir", default=None,
+                   help="fleet post-mortem from a gateway journal "
+                        "(dir or .jsonl): per-replica heartbeats, "
+                        "failovers, hedge wins/losses, breaker and "
+                        "degrade history, who broke the cohort; exits "
+                        "nonzero when accepted requests were lost")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_doctor)
 
@@ -2063,8 +2102,14 @@ def main(argv: list[str] | None = None) -> int:
                         "scenario (breach → replan → scale → recover) "
                         "twice and verify determinism; exit 1 on any "
                         "failed check")
+    p.add_argument("--chaos", action="store_true",
+                   help="run the fleet fault scenario (seeded replica "
+                        "kill/stall/slow mid-stream) and assert every "
+                        "accepted request completes with tokens "
+                        "bitwise-identical to a fault-free replay, "
+                        "deterministically across two runs")
     p.add_argument("--replicas", type=int, default=2,
-                   help="initial fleet size")
+                   help="initial fleet size (--chaos default: 4)")
     p.add_argument("--max-replicas", type=int, default=8,
                    dest="max_replicas",
                    help="autoscaler ceiling (smoke: the scale-out "

@@ -12,13 +12,20 @@ Three layers over the continuous-batching serve engine:
 - :mod:`.controller` — :class:`FleetController` watches live SLO
   windows and resizes the fleet through the planner's serving replay.
 
+:mod:`.fault` adds the fleet fault-tolerance layer — per-replica
+circuit breakers, tail hedging, and the degrade ladder — which the
+gateway wires to heartbeat-expiry failover and an exactly-once
+per-request token ledger.
+
 :mod:`.chaos` scripts the whole loop on a virtual clock (traffic flip
-→ breach → replan → recover) as a byte-replayable smoke scenario —
-``tadnn gateway --smoke`` in CI.
+→ breach → replan → recover, plus seeded replica kill/stall/slow) as
+byte-replayable scenarios — ``tadnn gateway --smoke`` and ``tadnn
+gateway --chaos`` in CI.
 """
 
-from .chaos import chaos_smoke, run_scenario
+from .chaos import chaos_smoke, fleet_chaos, run_scenario
 from .controller import AutoscalePolicy, FleetController
+from .fault import BreakerPolicy, CircuitBreaker, HedgePolicy
 from .ingress import (
     Gateway,
     GatewayError,
@@ -33,10 +40,13 @@ from .router import EngineReplica, NoHealthyReplica, Router, SimReplica
 
 __all__ = [
     "AutoscalePolicy",
+    "BreakerPolicy",
+    "CircuitBreaker",
     "EngineReplica",
     "FleetController",
     "Gateway",
     "GatewayError",
+    "HedgePolicy",
     "HttpIngress",
     "NoHealthyReplica",
     "RateLimited",
@@ -45,6 +55,7 @@ __all__ = [
     "SimReplica",
     "TokenBucket",
     "chaos_smoke",
+    "fleet_chaos",
     "run_scenario",
     "serve_forever",
     "sse_generate",

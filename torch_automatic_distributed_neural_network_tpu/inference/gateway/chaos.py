@@ -25,11 +25,13 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import random
 
 from ...obs.journal import Journal
 from ...tune.simulate import TrafficMix
 from ...tune.slo import SLOSpec
 from .controller import AutoscalePolicy
+from .fault import BreakerPolicy, HedgePolicy
 from .ingress import Gateway, GatewayError
 from .router import SimReplica
 
@@ -150,6 +152,202 @@ def run_scenario(journal: Journal, *, clock: list[float] | None = None,
     summary["offered"] = len(plan)
     summary["virtual_s"] = clock[0]
     return summary
+
+
+# -- fleet fault scenario -----------------------------------------------------
+#
+# The second chaos tier: instead of flipping traffic, it breaks the
+# FLEET — a seeded plan kills one replica mid-stream (heartbeat
+# failover), wedges another (circuit breaker + hedging), and slows a
+# third (hedging) — and the gate asserts that every accepted request
+# still completes with a token stream bitwise-identical to a
+# fault-free run of the same seed.  ``tadnn gateway --chaos`` in CI.
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultEvent:
+    """One scripted fault on the virtual clock."""
+
+    t: float
+    kind: str       # kill | stall | unstall | slow | restore
+    replica: int    # index into the initial fleet
+    factor: int = 1  # slow-down multiple (kind == "slow")
+
+
+def fault_plan(seed: int, n_replicas: int) -> list[FaultEvent]:
+    """The seeded fault schedule: one kill, one stall/unstall pair,
+    one slow/restore pair, on DISTINCT victims, never replica0 (the
+    fleet must keep at least one intact survivor so failover has
+    somewhere to land).  Same seed -> same plan, byte-for-byte."""
+    rng = random.Random(seed)
+    victims = list(range(1, n_replicas))
+    rng.shuffle(victims)
+    events: list[FaultEvent] = []
+    if victims:
+        events.append(FaultEvent(
+            round(rng.uniform(1.0, 2.0), 3), "kill", victims[0]))
+    if len(victims) > 1:
+        t = round(rng.uniform(0.6, 1.2), 3)
+        events.append(FaultEvent(t, "stall", victims[1]))
+        events.append(FaultEvent(
+            round(t + rng.uniform(0.8, 1.2), 3), "unstall", victims[1]))
+    if len(victims) > 2:
+        t = round(rng.uniform(0.4, 0.8), 3)
+        events.append(FaultEvent(t, "slow", victims[2], factor=64))
+        events.append(FaultEvent(
+            round(t + rng.uniform(1.0, 1.5), 3), "restore", victims[2]))
+    events.sort(key=lambda e: (e.t, e.kind, e.replica))
+    return events
+
+
+def _apply_fault(ev: FaultEvent, replica: SimReplica,
+                 journal: Journal) -> None:
+    if ev.kind == "kill":
+        replica.kill()
+    elif ev.kind == "stall":
+        replica.stalled = True
+    elif ev.kind == "unstall":
+        replica.stalled = False
+    elif ev.kind == "slow":
+        replica.slow_factor = max(1, int(ev.factor))
+    elif ev.kind == "restore":
+        replica.slow_factor = 1
+    else:
+        raise ValueError(f"unknown fault kind {ev.kind!r}")
+    journal.event("chaos.fault", kind=ev.kind, replica=replica.name,
+                  t_fault=ev.t, factor=ev.factor)
+
+
+def run_fleet_scenario(journal: Journal, *,
+                       clock: list[float] | None = None,
+                       seed: int = 0, n_replicas: int = 4,
+                       faults: bool = True,
+                       prefix_cache: bool = True,
+                       tick_s: float = 5e-3,
+                       horizon_s: float = 30.0
+                       ) -> tuple[dict, dict[int, list[int]]]:
+    """One pass of the fleet fault scenario; returns ``(summary,
+    streams)`` where ``streams`` maps every accepted rid to its
+    exactly-once delivered token list (the gateway ledger).
+
+    No rate limit and an effectively unbounded queue: BOTH the faulted
+    and the fault-free run must accept the identical request set, or
+    per-rid stream parity would be vacuous."""
+    if clock is None:
+        clock = [0.0]
+
+    def now() -> float:
+        return clock[0]
+
+    def make(name: str) -> SimReplica:
+        return SimReplica(name, n_slots=4, block_size=8, max_len=256,
+                          prefill_chunk=8, prefix_cache=prefix_cache,
+                          clock=now, journal=journal)
+
+    replicas = [make(f"replica{i}") for i in range(n_replicas)]
+    gw = Gateway(replicas, journal=journal, clock=now,
+                 queue_limit=100_000,
+                 heartbeat_s=tick_s * 10,
+                 hedge=HedgePolicy(after_s=0.2,
+                                   max_hedges_per_request=1),
+                 breaker=BreakerPolicy(window_s=0.1,
+                                       min_observations=10,
+                                       failure_rate=0.5,
+                                       open_s=0.3, clean_s=0.1),
+                 step_costs=(tick_s, tick_s))
+    plan = arrivals([ChaosPhase(0.0, 5.0, TrafficMix(
+        rate_per_s=80.0, n_requests=400, prompt_mean=24, max_new=12,
+        decode_mean=12, jitter=0.0, seed=seed,
+        shared_prefix=SHARED_PREFIX))])
+    fplan = fault_plan(seed, n_replicas) if faults else []
+    expected: dict[int, int] = {}   # rid -> emulated true decode len
+    i = f = 0
+    while clock[0] < horizon_s and (
+            i < len(plan) or f < len(fplan)
+            or not gw.idle() or gw._meta):
+        t = clock[0]
+        while f < len(fplan) and fplan[f].t <= t:
+            _apply_fault(fplan[f], replicas[fplan[f].replica], journal)
+            f += 1
+        while i < len(plan) and plan[i][0] <= t:
+            _, prompt, max_new, n_dec, tenant = plan[i]
+            try:
+                req = gw.submit(prompt, max_new, tenant=tenant,
+                                eos_id=0, n_decode=n_dec)
+                expected[req.rid] = n_dec
+            except GatewayError:
+                pass
+            i += 1
+        gw.step()
+        clock[0] = t + tick_s
+    summary = gw.summary()
+    summary["offered"] = len(plan)
+    summary["virtual_s"] = clock[0]
+    summary["n_faults"] = len(fplan)
+    streams = {rid: gw.delivered(rid) for rid in expected}
+    summary["complete"] = all(
+        len(streams[rid]) == n_dec and streams[rid][-1] == 0
+        for rid, n_dec in expected.items())
+    return summary, streams
+
+
+def fleet_chaos(*, journal_path: str | None = None, seed: int = 0,
+                n_replicas: int = 4) -> dict:
+    """The ``tadnn gateway --chaos`` CI gate.
+
+    Three runs of the SAME seeded traffic: a fault-free baseline, a
+    faulted run journaled to ``journal_path``, and a second faulted
+    run in memory.  Holds iff
+
+    - the two faulted runs journal identical normalized event
+      sequences AND identical per-rid streams (determinism);
+    - every accepted request completed, and each rid's delivered
+      stream is bitwise-identical to the fault-free baseline's
+      (failover/hedging lost and duplicated nothing);
+    - at least one replica was killed while it held in-flight work
+      (the kill really was mid-stream)."""
+
+    def one(path: str | None, faults: bool
+            ) -> tuple[dict, dict, list[dict]]:
+        clock = [0.0]
+        j = Journal(path, host0_only=False, clock=lambda: clock[0],
+                    meta={"tool": "gateway-fleet-chaos"})
+        with j:
+            summary, streams = run_fleet_scenario(
+                j, clock=clock, seed=seed, n_replicas=n_replicas,
+                faults=faults)
+        records = (Journal.read(path) if path else list(j.records))
+        return summary, streams, records
+
+    s0, st0, _ = one(None, False)
+    s1, st1, r1 = one(journal_path, True)
+    s2, st2, r2 = one(None, True)
+    deterministic = (_normalize(r1) == _normalize(r2) and st1 == st2)
+    parity = st1 == st0
+    completed = bool(s1["complete"] and s1["done"] == s1["accepted"])
+    killed_inflight = any(
+        rec.get("name") == "gateway.failover"
+        and rec.get("n_requeued", 0) > 0 for rec in r1)
+    ok = (deterministic and parity and completed and killed_inflight
+          and s0["complete"])
+    return {
+        "ok": ok,
+        "deterministic": deterministic,
+        "stream_parity": parity,
+        "all_completed": completed,
+        "killed_inflight": killed_inflight,
+        "baseline_complete": s0["complete"],
+        "seed": seed,
+        "accepted": s1["accepted"],
+        "failovers": s1["failovers"],
+        "hedges": s1["hedges"],
+        "hedge_wins": s1["hedge_wins"],
+        "breakers": s1.get("breakers", {}),
+        "n_records": len(r1),
+        "fault_plan": [dataclasses.asdict(e)
+                       for e in fault_plan(seed, n_replicas)],
+        "run": s1,
+    }
 
 
 def _normalize(records: list[dict]) -> list[str]:

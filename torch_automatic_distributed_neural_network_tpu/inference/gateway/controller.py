@@ -52,6 +52,12 @@ class AutoscalePolicy:
     cooldown_windows: int = 4
     # consecutive clean windows before a scale-IN is even considered
     scale_in_after: int = 8
+    # degraded modes: consecutive breached windows WITH the fleet
+    # already at max_replicas before the controller walks the gateway
+    # one degrade level down (shed/starve instead of scale); 0
+    # disables.  Each further streak of the same length degrades one
+    # more level, and recovery restores one level per clean streak.
+    degrade_after: int = 4
     # candidate-evaluation traffic model: the replay must cover a
     # SUSTAINED stretch of the measured arrival rate — a too-short
     # burst drains inside the sim and under-prices queueing, which is
@@ -86,6 +92,7 @@ class FleetController:
             journal=journal)
         self._cooldown = 0
         self._clean_streak = 0
+        self._breach_at_max = 0
         self.replans: list[dict] = []
         self.windows_seen = 0
 
@@ -116,6 +123,7 @@ class FleetController:
             self._clean_streak = 0
         else:
             self._clean_streak += 1
+        self._maybe_degrade(breach_active)
         if self._cooldown > 0:
             return
         n_now = self.gateway.n_active_replicas()
@@ -125,6 +133,35 @@ class FleetController:
         elif (self._clean_streak >= self.policy.scale_in_after
               and n_now > self.policy.min_replicas):
             self._replan(window, reason="surplus")
+
+    def _maybe_degrade(self, breach_active: bool) -> None:
+        """Degrade ladder: when scaling out is no longer an option
+        (breached AND at max_replicas) shedding load is — walk the
+        gateway one level per sustained streak, and back one level per
+        clean streak.  Degrade is NOT gated on the resize cooldown:
+        shedding is the pressure valve for exactly the windows where a
+        resize can't help."""
+        pol = self.policy
+        if pol.degrade_after <= 0 or not hasattr(self.gateway,
+                                                 "set_degrade"):
+            return
+        at_max = (self.gateway.n_active_replicas()
+                  >= pol.max_replicas)
+        if breach_active and at_max:
+            self._breach_at_max += 1
+            if self._breach_at_max >= pol.degrade_after:
+                self._breach_at_max = 0
+                self.gateway.set_degrade(
+                    self.gateway.degrade_level + 1,
+                    reason="sustained breach at max fleet")
+        else:
+            self._breach_at_max = 0
+            if (self.gateway.degrade_level > 0
+                    and self._clean_streak >= pol.recover_after):
+                self._clean_streak = 0
+                self.gateway.set_degrade(
+                    self.gateway.degrade_level - 1,
+                    reason="slo recovered")
 
     def _replan(self, window: dict, *, reason: str) -> None:
         """Ask the serving replay for the cheapest compliant fleet

@@ -45,6 +45,13 @@ from ...obs import journal as journal_mod
 from ...obs.journal import Journal
 from ..serve.scheduler import Request
 from .controller import AutoscalePolicy, FleetController
+from .fault import (
+    MAX_DEGRADE_LEVEL,
+    BreakerPolicy,
+    CircuitBreaker,
+    HedgePolicy,
+    degrade_effects,
+)
 from .router import NoHealthyReplica, Router
 
 PRIORITY_CLASSES = {"interactive": 0, "batch": 1}
@@ -52,6 +59,9 @@ PRIORITY_CLASSES = {"interactive": 0, "batch": 1}
 
 class GatewayError(RuntimeError):
     status = 500
+    # advisory back-off (seconds) the HTTP layer maps to a Retry-After
+    # header on 429/503 — None means no estimate
+    retry_after: float | None = None
 
 
 class RateLimited(GatewayError):
@@ -86,6 +96,16 @@ class TokenBucket:
             return True
         return False
 
+    def seconds_until_token(self) -> float:
+        """Refill time until the next ``try_take`` could succeed —
+        the 429 response's Retry-After."""
+        now = self.clock()
+        tokens = min(self.burst,
+                     self.tokens + (now - self._last) * self.rate)
+        if tokens >= 1.0 or self.rate <= 0:
+            return 0.0
+        return (1.0 - tokens) / self.rate
+
 
 class Gateway:
     """Sync, clock-injected gateway core: admission control, routing,
@@ -102,6 +122,10 @@ class Gateway:
                  burst: int | None = None,
                  queue_limit: int = 64,
                  router_policy: str = "affinity",
+                 heartbeat_s: float | None = None,
+                 hedge: HedgePolicy | None = None,
+                 breaker: BreakerPolicy | None = None,
+                 stream_retention: int = 65536,
                  step_costs: tuple[float, float] = (1e-3, 1e-3),
                  traffic_horizon_s: float = 8.0):
         if not replicas:
@@ -133,6 +157,35 @@ class Gateway:
         self.n_accepted = 0
         self.n_rejected = 0
         self.n_done = 0
+        # -- fault tolerance --------------------------------------------------
+        # no heartbeat within this window => the replica is declared
+        # dead and its in-flight work fails over (None disables)
+        self.heartbeat_s = heartbeat_s
+        self.hedge = hedge
+        self.breaker_policy = breaker
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self._replica_marker: dict[str, Any] = {}  # name -> last steps ctr
+        if breaker is not None and self.router.gate is None:
+            self.router.gate = self._breaker_allows
+        # exactly-once token ledger: rid -> every token DELIVERED so
+        # far, a monotone cursor over whichever copy of the request is
+        # furthest along.  Preemption/failover shrink a copy's
+        # out_tokens; the ledger never rolls back, so a resumed stream
+        # is deduplicated (greedy recompute re-derives the same ids)
+        self._delivered: dict[int, list[int]] = {}
+        self._progress_t: dict[int, float] = {}  # rid -> last new token
+        self._done_rids: deque = deque()
+        self.stream_retention = int(stream_retention)
+        self._rid_alias: dict[int, int] = {}     # engine hedge rid map
+        self._orphans: list[Request] = []        # awaiting any replica
+        self.n_failovers = 0
+        self.n_hedges = 0
+        self.n_hedge_wins = 0
+        # degraded-mode state (fault.degrade_effects): level 0 = normal
+        self.degrade_level = 0
+        self.speculation_enabled = True
+        self._admission_factor = 1.0
+        self._shed_threshold: int | None = None
         self.controller = (FleetController(self, autoscale,
                                            journal=self.journal)
                            if autoscale is not None else None)
@@ -173,34 +226,75 @@ class Gateway:
         self._submits.append((self.clock(), len(prompt),
                               int(max_new_tokens),
                               int(n_decode or max_new_tokens)))
+        if (self._shed_threshold is not None
+                and int(priority) >= self._shed_threshold):
+            self.n_rejected += 1
+            retry = self._queue_drain_estimate()
+            self.journal.event("gateway.reject", kind="degraded",
+                              tenant=tenant, priority=int(priority),
+                              level=self.degrade_level,
+                              retry_after=round(retry, 6))
+            err = Saturated(
+                f"priority class {int(priority)} shed at degrade "
+                f"level {self.degrade_level}")
+            err.retry_after = retry
+            raise err
         bucket = self._bucket(tenant)
         if bucket is not None and not bucket.try_take():
             self.n_rejected += 1
+            retry = bucket.seconds_until_token()
             self.journal.event("gateway.reject", kind="rate_limit",
-                              tenant=tenant)
-            raise RateLimited(f"tenant {tenant!r} over rate limit")
-        if self._pending.get(tenant, 0) >= self.queue_limit:
+                              tenant=tenant,
+                              retry_after=round(retry, 6))
+            err = RateLimited(f"tenant {tenant!r} over rate limit")
+            err.retry_after = retry
+            raise err
+        limit = max(1, int(self.queue_limit * self._admission_factor))
+        if self._pending.get(tenant, 0) >= limit:
             self.n_rejected += 1
+            retry = self._queue_drain_estimate()
             self.journal.event("gateway.reject", kind="backpressure",
                               tenant=tenant,
-                              pending=self._pending[tenant])
-            raise Saturated(
+                              pending=self._pending[tenant],
+                              retry_after=round(retry, 6))
+            err = Saturated(
                 f"tenant {tenant!r} has {self._pending[tenant]} "
-                f"requests in flight (limit {self.queue_limit})")
+                f"requests in flight (limit {limit})")
+            err.retry_after = retry
+            raise err
         replica = self.router.route(prompt)
         rid = self._next_rid
         self._next_rid += 1
         req = replica.submit(prompt, max_new_tokens, eos_id=eos_id,
                              priority=int(priority), n_decode=n_decode,
                              rid=rid)
+        if req.rid != rid:
+            # EngineReplica mints its own rids; alias them back so the
+            # ledger, hedging and failover all key on the gateway rid
+            self._rid_alias[req.rid] = rid
         self._pending[tenant] = self._pending.get(tenant, 0) + 1
-        self._meta[req.rid] = {"tenant": tenant, "replica": replica,
-                               "n_decode": n_decode, "req": req}
+        self._meta[rid] = {"tenant": tenant, "replica": replica,
+                           "n_decode": n_decode, "req": req,
+                           "hedge": None, "n_hedges": 0}
+        self._delivered[rid] = []
+        self._progress_t[rid] = self.clock()
         self.n_accepted += 1
-        self.journal.event("gateway.request", rid=req.rid,
+        self.journal.event("gateway.request", rid=rid,
                            tenant=tenant, priority=int(priority),
                            replica=replica.name, n_prompt=len(prompt))
         return req
+
+    def _queue_drain_estimate(self) -> float:
+        """Advisory Retry-After for 503s: pending work / fleet decode
+        throughput, floored so clients never hot-loop."""
+        pending = sum(self._pending.values())
+        slots = sum(r.n_slots for r in self.active_replicas()) or 1
+        decode_mean = 8.0
+        if self._submits:
+            decode_mean = (sum(s[3] for s in self._submits)
+                           / len(self._submits))
+        est = pending * decode_mean * self.step_costs[1] / slots
+        return max(0.05, est)
 
     # -- serving loop --------------------------------------------------------
 
@@ -219,20 +313,247 @@ class Gateway:
         requests that finished this step (pending counts released).
         The journal tap feeds the controller as records are written —
         a breach detected in this step's windows can resize the fleet
-        before the next step."""
+        before the next step.
+
+        Fault-tolerance order matters: harvest tokens into the ledger
+        BEFORE declaring anything dead (so failover never loses
+        already-computed tokens), then breakers, then heartbeat
+        failover, then hedging, then resolution."""
+        self._place_orphans()
         finished: list[Request] = []
         for r in list(self.router.replicas):
             if r.retired:
                 continue
             r.step()
             finished.extend(r.take_finished())
+        now = self.clock()
+        self._harvest(now)
+        if self.breaker_policy is not None:
+            self._feed_breakers(now)
+        if self.heartbeat_s is not None:
+            for r in list(self.router.replicas):
+                if r.retired or r.draining:
+                    continue
+                if now - r.last_step_t > self.heartbeat_s:
+                    self._failover(r, reason="heartbeat_expired")
+        if self.hedge is not None:
+            self._maybe_hedge(now)
+        return self._resolve(finished)
+
+    # -- fault tolerance -----------------------------------------------------
+
+    def _gw_rid(self, rid: int) -> int:
+        return self._rid_alias.get(rid, rid)
+
+    def _breaker(self, name: str) -> CircuitBreaker:
+        br = self._breakers.get(name)
+        if br is None:
+            br = self._breakers[name] = CircuitBreaker(
+                name, self.breaker_policy, clock=self.clock,
+                journal=self.journal)
+        return br
+
+    def _breaker_allows(self, replica) -> bool:
+        br = self._breakers.get(replica.name)
+        return br is None or br.allow()
+
+    def _harvest(self, now: float) -> None:
+        """Advance every rid's delivered-token ledger to the furthest
+        copy.  The ledger is the exactly-once cursor: it only ever
+        extends, so a preempted/failed-over copy whose out_tokens
+        shrank is waited out (greedy recompute re-derives the same
+        ids) and a hedged copy merges losslessly."""
+        for rid, meta in self._meta.items():
+            ledger = self._delivered.get(rid)
+            if ledger is None:
+                ledger = self._delivered[rid] = []
+            best = meta["req"].out_tokens
+            h = meta.get("hedge")
+            if h is not None and len(h["req"].out_tokens) > len(best):
+                best = h["req"].out_tokens
+            if len(best) > len(ledger):
+                ledger.extend(best[len(ledger):])
+                self._progress_t[rid] = now
+
+    def _feed_breakers(self, now: float) -> None:
+        """One observation per loaded replica per step: ok iff its
+        steps counter advanced.  A stalled-but-heartbeating replica
+        accumulates failures and is opened out of routing before the
+        heartbeat or the autoscaler can react."""
+        for r in self.router.replicas:
+            if r.retired:
+                continue
+            br = self._breaker(r.name)
+            marker = getattr(r, "steps", None)
+            if not getattr(r, "idle", lambda: True)():
+                prev = self._replica_marker.get(r.name)
+                br.observe(marker is None or marker != prev)
+            self._replica_marker[r.name] = marker
+            br.tick()
+
+    def _failover(self, replica, *, reason: str) -> None:
+        """Declare ``replica`` dead: drain its in-flight work through
+        the scheduler's class-preserving requeue and re-route every
+        request under its ORIGINAL rid.  Prefill restarts cheaply on
+        the survivors via prefix-cache hits; the ledger guarantees the
+        resumed stream is exactly-once."""
+        salvaged = replica.drain()
+        self.router.forget(replica.name)
+        self.n_failovers += 1
+        self.journal.event(
+            "gateway.failover", replica=replica.name, reason=reason,
+            n_requeued=len(salvaged),
+            rids=[self._gw_rid(r.rid) for r in salvaged])
+        self._redispatch(salvaged)
+
+    def _redispatch(self, reqs: Sequence[Request], *,
+                    quiet: bool = False) -> None:
+        for req in reqs:
+            rid = self._gw_rid(req.rid)
+            meta = self._meta.get(rid)
+            if meta is None:
+                continue
+            h = meta.get("hedge")
+            if h is not None and req is h["req"]:
+                # the dead replica held the hedge CLONE — drop it, the
+                # primary copy elsewhere is still live
+                meta["hedge"] = None
+                if req.rid != rid:
+                    self._rid_alias.pop(req.rid, None)
+                continue
+            try:
+                target = self.router.route(req.prompt)
+            except NoHealthyReplica:
+                self._orphans.append(req)
+                if not quiet:
+                    self.journal.event("gateway.failover",
+                                       kind="parked", rid=rid)
+                continue
+            target.resubmit(req, n_decode=meta.get("n_decode"))
+            meta["replica"] = target
+
+    def _place_orphans(self) -> None:
+        """Retry requests salvaged while no replica was healthy."""
+        if not self._orphans:
+            return
+        orphans, self._orphans = self._orphans, []
+        self._redispatch(orphans, quiet=True)
+
+    def _maybe_hedge(self, now: float) -> None:
+        """Re-dispatch no-progress requests to a second replica under
+        the same rid; first writer wins at resolve time."""
+        pol = self.hedge
+        for rid, meta in list(self._meta.items()):
+            if meta.get("hedge") is not None:
+                continue
+            if meta["n_hedges"] >= pol.max_hedges_per_request:
+                continue
+            if now - self._progress_t.get(rid, now) < pol.after_s:
+                continue
+            current = meta["replica"]
+            candidates = [r for r in self.router.healthy()
+                          if r is not current]
+            if not candidates:
+                continue
+            target = min(candidates, key=lambda r: r.load())
+            req = meta["req"]
+            clone = target.submit(
+                list(req.prompt), req.max_new_tokens,
+                eos_id=req.eos_id, priority=req.priority,
+                n_decode=meta.get("n_decode"), rid=rid)
+            if clone.rid != rid:
+                self._rid_alias[clone.rid] = rid
+            meta["hedge"] = {"req": clone, "replica": target}
+            meta["n_hedges"] += 1
+            self.n_hedges += 1
+            self._progress_t[rid] = now
+            self.journal.event(
+                "gateway.hedge", kind="dispatch", rid=rid,
+                replica=target.name, primary=current.name)
+
+    def _resolve(self, finished: list[Request]) -> list[Request]:
+        """Resolution with first-writer-wins hedge semantics: only the
+        first copy of a rid to finish counts; the loser is cancelled
+        on its replica and its finish (if it races in the same step)
+        is ignored."""
+        out: list[Request] = []
         for req in finished:
-            meta = self._meta.pop(req.rid, None)
-            if meta is not None:
-                t = meta["tenant"]
-                self._pending[t] = max(0, self._pending.get(t, 1) - 1)
+            rid = self._gw_rid(req.rid)
+            meta = self._meta.pop(rid, None)
+            if meta is None:
+                continue  # the losing copy of an already-resolved rid
+            h = meta.get("hedge")
+            if h is not None:
+                winner_is_hedge = req is h["req"]
+                loser_req = meta["req"] if winner_is_hedge else h["req"]
+                loser_rep = (meta["replica"] if winner_is_hedge
+                             else h["replica"])
+                if not getattr(loser_rep, "retired", False):
+                    loser_rep.cancel(loser_req.rid)
+                if winner_is_hedge:
+                    self.n_hedge_wins += 1
+                self.journal.event(
+                    "gateway.hedge", kind="win", rid=rid,
+                    winner=("hedge" if winner_is_hedge else "primary"))
+            ledger = self._delivered.get(rid)
+            if ledger is not None and len(req.out_tokens) > len(ledger):
+                ledger.extend(req.out_tokens[len(ledger):])
+            t = meta["tenant"]
+            self._pending[t] = max(0, self._pending.get(t, 1) - 1)
             self.n_done += 1
-        return finished
+            self._done_rids.append(rid)
+            out.append(req)
+        while len(self._done_rids) > self.stream_retention:
+            old = self._done_rids.popleft()
+            self._delivered.pop(old, None)
+            self._progress_t.pop(old, None)
+            # aliases (engine-minted rids) live until their stream is
+            # trimmed so the HTTP pump can map finished requests back
+            stale = [k for k, v in self._rid_alias.items() if v == old]
+            for k in stale:
+                del self._rid_alias[k]
+        return out
+
+    def delivered(self, rid: int) -> list[int]:
+        """The exactly-once token stream for ``rid`` (a copy)."""
+        return list(self._delivered.get(rid, ()))
+
+    # -- degraded modes ------------------------------------------------------
+
+    def set_degrade(self, level: int, *, reason: str = "") -> None:
+        """Walk the degrade ladder (idempotent, journaled): level 1
+        disables speculation and halves admission; level 2+ sheds
+        priority classes lowest-first, never interactive."""
+        level = max(0, min(MAX_DEGRADE_LEVEL, int(level)))
+        if level == self.degrade_level:
+            return
+        rising = level > self.degrade_level
+        effects = degrade_effects(
+            level, list(PRIORITY_CLASSES.values()))
+        self._apply_speculation(effects["speculation"])
+        self._admission_factor = effects["admission_factor"]
+        self._shed_threshold = effects["shed_threshold"]
+        prev = self.degrade_level
+        self.degrade_level = level
+        self.journal.event(
+            "gateway.degrade" if rising else "gateway.restore",
+            level=level, prev=prev, reason=reason, **{
+                k: v for k, v in effects.items() if k != "level"})
+
+    def _apply_speculation(self, enabled: bool) -> None:
+        if enabled == self.speculation_enabled:
+            return
+        self.speculation_enabled = enabled
+        for r in self.router.replicas:
+            engine = getattr(r, "engine", None)
+            if engine is None or not hasattr(engine, "speculative"):
+                continue
+            if not enabled:
+                r._stashed_speculative = engine.speculative
+                engine.speculative = 0
+            else:
+                engine.speculative = getattr(
+                    r, "_stashed_speculative", engine.speculative)
 
     def run_until_idle(self, *, max_steps: int = 100_000
                        ) -> list[Request]:
@@ -248,7 +569,10 @@ class Gateway:
     def replica_shape(self) -> dict:
         """The active replicas' scheduling shape, for the controller's
         candidate replay (homogeneous fleet assumed)."""
-        r = self.active_replicas()[0]
+        active = self.active_replicas()
+        # after a failover storm the active set can momentarily be
+        # empty; any replica's shape works (homogeneous fleet)
+        r = active[0] if active else self.router.replicas[0]
         return {
             "n_slots": r.n_slots,
             "block_size": r.block_size,
@@ -314,13 +638,7 @@ class Gateway:
                 "gateway.scale", kind="in", replica=victim.name,
                 reason=reason, requeued=len(drained),
                 n_replicas=self.n_active_replicas())
-            for req in drained:
-                meta = self._meta.get(req.rid)
-                target = self.router.route(req.prompt)
-                target.resubmit(
-                    req, n_decode=(meta or {}).get("n_decode"))
-                if meta is not None:
-                    meta["replica"] = target
+            self._redispatch(drained)
 
     # -- summary -------------------------------------------------------------
 
@@ -336,7 +654,15 @@ class Gateway:
             "prefix_queries": sum(p["queries"] for p in prefix),
             "prefix_hit_requests": sum(p["hit_requests"]
                                        for p in prefix),
+            "failovers": self.n_failovers,
+            "hedges": self.n_hedges,
+            "hedge_wins": self.n_hedge_wins,
+            "degrade_level": self.degrade_level,
+            "parked": len(self._orphans),
         }
+        if self._breakers:
+            out["breakers"] = {name: br.state
+                               for name, br in self._breakers.items()}
         if self.controller is not None:
             out["controller"] = self.controller.stats()
         return out
@@ -349,15 +675,27 @@ def _sse(data: dict) -> bytes:
     return f"data: {json.dumps(data)}\n\n".encode()
 
 
-def _http_response(status: int, body: dict) -> bytes:
+def _http_response(status: int, body: dict,
+                   headers: dict[str, str] | None = None) -> bytes:
     payload = json.dumps(body).encode()
     reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
               429: "Too Many Requests",
               503: "Service Unavailable"}.get(status, "Error")
+    extra = "".join(f"{k}: {v}\r\n"
+                    for k, v in (headers or {}).items())
     return (f"HTTP/1.1 {status} {reason}\r\n"
             f"Content-Type: application/json\r\n"
             f"Content-Length: {len(payload)}\r\n"
+            f"{extra}"
             f"Connection: close\r\n\r\n").encode() + payload
+
+
+def _retry_headers(e: GatewayError) -> dict[str, str] | None:
+    """RFC 7231 Retry-After (integer seconds, ceil, min 1) for
+    throttle/backpressure responses carrying an estimate."""
+    if e.retry_after is None:
+        return None
+    return {"Retry-After": str(max(1, int(-(-e.retry_after // 1))))}
 
 
 _SSE_HEADER = (b"HTTP/1.1 200 OK\r\n"
@@ -410,28 +748,27 @@ class HttpIngress:
                 continue
             finished = (self.gateway.step()
                         if not self.gateway.idle() else [])
+            gw = self.gateway
             for rid, q in list(self._streams.items()):
-                req = self.gateway._meta.get(rid, {}).get("req")
-                if req is None:
-                    req = next((r for r in finished if r.rid == rid),
-                               None)
-                if req is None:
+                # the gateway's delivered-token ledger IS the stream:
+                # it survives preemption, failover and hedging and
+                # only ever extends, so emitting everything past our
+                # high-water mark is exactly-once by construction
+                ledger = gw._delivered.get(rid)
+                if ledger is None:
                     continue
                 sent = self._sent.get(rid, 0)
-                # a preempted request regenerates from scratch: its
-                # out_tokens shrank below what we already streamed —
-                # greedy recompute reproduces the same ids, so wait
-                # silently until it passes the high-water mark
-                for i in range(sent, len(req.out_tokens)):
-                    q.put_nowait({"i": i, "token": req.out_tokens[i]})
-                self._sent[rid] = max(sent, len(req.out_tokens))
+                for i in range(sent, len(ledger)):
+                    q.put_nowait({"i": i, "token": ledger[i]})
+                self._sent[rid] = max(sent, len(ledger))
             for req in finished:
-                q = self._streams.get(req.rid)
+                rid = gw._gw_rid(req.rid)
+                q = self._streams.get(rid)
                 if q is not None:
                     total = (req.t_done - req.t_submit
                              if req.t_done is not None else None)
                     q.put_nowait({
-                        "done": True, "rid": req.rid,
+                        "done": True, "rid": rid,
                         "usage": {"n_prompt": req.n_prompt,
                                   "n_new": req.n_generated,
                                   "cached_tokens": req.cached_tokens,
@@ -495,16 +832,20 @@ class HttpIngress:
                 priority=payload.get("priority", "interactive"),
                 eos_id=payload.get("eos_id"))
         except (RateLimited, Saturated) as e:
-            writer.write(_http_response(e.status, {"error": str(e)}))
+            writer.write(_http_response(e.status, {"error": str(e)},
+                                        headers=_retry_headers(e)))
             await writer.drain()
             return
         except (NoHealthyReplica, ValueError) as e:
             writer.write(_http_response(503, {"error": str(e)}))
             await writer.drain()
             return
+        # key the stream by the GATEWAY rid (engines mint their own;
+        # the ledger, failover and hedging all speak gateway rids)
+        rid = self.gateway._gw_rid(req.rid)
         q: asyncio.Queue = asyncio.Queue()
-        self._streams[req.rid] = q
-        self._sent[req.rid] = 0
+        self._streams[rid] = q
+        self._sent[rid] = 0
         writer.write(_SSE_HEADER)
         await writer.drain()
         try:
@@ -515,8 +856,8 @@ class HttpIngress:
                 if item.get("done"):
                     break
         finally:
-            self._streams.pop(req.rid, None)
-            self._sent.pop(req.rid, None)
+            self._streams.pop(rid, None)
+            self._sent.pop(rid, None)
 
 
 async def serve_forever(gateway: Gateway, *, host: str = "127.0.0.1",

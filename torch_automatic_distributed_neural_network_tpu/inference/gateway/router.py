@@ -38,7 +38,7 @@ Two replica flavors, one protocol (submit/step/load/drain/idle):
 from __future__ import annotations
 
 import time
-from typing import Callable, Sequence
+from typing import Any, Callable, Sequence
 
 from ..serve.kv_pool import BlockAllocator, blocks_for_tokens
 from ..serve.prefix_cache import PrefixCache, block_hashes
@@ -98,6 +98,14 @@ class SimReplica:
         self._taken = 0  # finished-list cursor for take_finished
         self.draining = False
         self.retired = False
+        # chaos fault state: a dead replica stops heartbeating (the
+        # gateway's failover trigger); a stalled one heartbeats but
+        # never advances (the circuit breaker's target); slow_factor n
+        # makes only every n-th step do work (the hedging target)
+        self.alive = True
+        self.stalled = False
+        self.slow_factor = 1
+        self._slow_phase = 0
         self.last_step_t = clock()
         self.steps = 0
         self.prompt_tokens = 0
@@ -150,6 +158,33 @@ class SimReplica:
         self._taken = len(self.finished)
         return out
 
+    # -- fault injection / recovery ------------------------------------------
+
+    def kill(self) -> None:
+        """Hard-kill: the replica stops stepping AND stops advancing
+        its heartbeat, so the gateway's expiry check sees it die."""
+        self.alive = False
+
+    def cancel(self, rid: int) -> bool:
+        """Remove one request (by rid) from this replica without
+        journaling a completion — the hedge loser / stale-copy path.
+        Queue first, then slots (blocks freed, no request_done span).
+        Returns False when no copy of ``rid`` is resident here."""
+        sched = self.scheduler
+        for r in list(sched.queue):
+            if r.rid == rid:
+                sched.queue.remove(r)
+                self._n_decode.pop(rid, None)
+                return True
+        for s in range(self.n_slots):
+            r = sched.slots[s]
+            if r is not None and r.rid == rid:
+                sched.evict(s)
+                self._n_decode.pop(rid, None)
+                self._prefill_pos.pop(rid, None)
+                return True
+        return False
+
     # -- one serving iteration ----------------------------------------------
 
     def _emit(self, req: Request) -> None:
@@ -189,8 +224,16 @@ class SimReplica:
         chunks, decode every running slot.  Returns tokens emitted.
         Journals ``serve.step`` only when there was work — an idle
         replica is silent, like an idle engine."""
+        if not self.alive:
+            return 0  # dead: no heartbeat, no progress
         sched = self.scheduler
         self.last_step_t = self.clock()
+        if self.stalled:
+            return 0  # wedged: heartbeats but never advances
+        if self.slow_factor > 1:
+            self._slow_phase = (self._slow_phase + 1) % self.slow_factor
+            if self._slow_phase != 0:
+                return 0
         if sched.idle():
             return 0
         new_tokens = 0
@@ -303,6 +346,7 @@ class EngineReplica:
         self.max_len = engine.max_len
         self.draining = False
         self.retired = False
+        self.alive = True
         self.last_step_t = clock()
         self._taken = 0
         self.prompt_tokens = 0
@@ -342,6 +386,21 @@ class EngineReplica:
         self._taken = len(self.engine.finished)
         return out
 
+    def cancel(self, rid: int) -> bool:
+        """Drop one request (hedge loser) without a completion span —
+        the engine twin of :meth:`SimReplica.cancel`."""
+        sched = self.engine.scheduler
+        for r in list(sched.queue):
+            if r.rid == rid:
+                sched.queue.remove(r)
+                return True
+        for s in range(sched.n_slots):
+            r = sched.slots[s]
+            if r is not None and r.rid == rid:
+                sched.evict(s)
+                return True
+        return False
+
     def drain(self) -> list[Request]:
         self.draining = True
         sched = self.engine.scheduler
@@ -374,6 +433,7 @@ class Router:
                  policy: str = "affinity",
                  imbalance_factor: float = 2.0,
                  heartbeat_s: float | None = None,
+                 gate: Callable[[Any], bool] | None = None,
                  clock: Callable[[], float] = time.monotonic,
                  journal=None):
         if policy not in ("affinity", "least_loaded"):
@@ -383,6 +443,10 @@ class Router:
         self.policy = policy
         self.imbalance_factor = float(imbalance_factor)
         self.heartbeat_s = heartbeat_s
+        # optional routing gate (the gateway's per-replica circuit
+        # breakers): a replica the gate vetoes takes no NEW placements
+        # but keeps its in-flight work and its affinity claims
+        self.gate = gate
         self.clock = clock
         self.journal = journal
         # chained content-hash key -> replica NAME that first prefilled
@@ -392,18 +456,32 @@ class Router:
         self.n_routed = 0
         self.n_affinity = 0
         self.n_fallback = 0
+        self.n_decayed = 0
 
     def healthy(self) -> list:
         out = []
         now = self.clock()
         for r in self.replicas:
-            if r.draining or r.retired:
+            if r.draining or r.retired or not getattr(r, "alive", True):
                 continue
             if (self.heartbeat_s is not None
                     and now - r.last_step_t > self.heartbeat_s):
                 continue
+            if self.gate is not None and not self.gate(r):
+                continue
             out.append(r)
         return out
+
+    def _owner_dead(self, name: str | None,
+                    by_name: dict[str, Any]) -> bool:
+        """True when a claim's owner no longer exists as a live
+        replica (retired, killed, or forgotten) — its KV is gone for
+        good, so the claim is a corpse, not a temporary outage."""
+        if name is None:
+            return False
+        rep = by_name.get(name)
+        return (rep is None or rep.retired
+                or not getattr(rep, "alive", True))
 
     def route(self, prompt: Sequence[int]):
         """Pick the replica for ``prompt`` and stamp its content keys.
@@ -442,8 +520,17 @@ class Router:
             self.n_affinity += 1
         else:
             self.n_fallback += 1
+        all_by_name = {r.name: r for r in self.replicas}
         for key in keys:
-            self._owner.setdefault(key, chosen.name)
+            cur = self._owner.get(key)
+            if cur is None:
+                self._owner[key] = chosen.name
+            elif self._owner_dead(cur, all_by_name):
+                # decay: the owning replica is dead, its KV with it —
+                # re-own the block where this traffic actually lands
+                # so failover traffic stops chasing the corpse
+                self._owner[key] = chosen.name
+                self.n_decayed += 1
         return chosen
 
     def forget(self, name: str) -> int:
@@ -458,4 +545,5 @@ class Router:
         return {"n_routed": self.n_routed,
                 "n_affinity": self.n_affinity,
                 "n_fallback": self.n_fallback,
+                "n_decayed": self.n_decayed,
                 "owned_keys": len(self._owner)}

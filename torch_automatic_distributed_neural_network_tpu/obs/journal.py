@@ -260,7 +260,14 @@ class Journal:
         before the engine's first event (the gateway does exactly
         this): creation is polled for under the same ``idle_timeout``
         budget instead of raising.
-        """
+
+        Size-capped rotation is survived: when the writer rotates the
+        file out from under the tail (``os.replace`` to ``<path>.1`` —
+        the open fd now points at the OLD generation) or truncates it,
+        the follower detects the inode swap / size shrink, reopens the
+        fresh file from the top, and warns once per rotation; a torn
+        buffer from the old generation is dropped (its tail lives in
+        ``<path>.1``, not the stream)."""
         buf = ""
         idle = 0.0
         while not os.path.exists(path):
@@ -271,7 +278,8 @@ class Journal:
             sleep(poll_s)
             idle += poll_s
         idle = 0.0
-        with open(path) as f:
+        f = open(path)
+        try:
             while True:
                 chunk = f.read()
                 if chunk:
@@ -295,12 +303,41 @@ class Journal:
                                 f"journal {path}: skipping torn/corrupt "
                                 f"line(s) while following", stacklevel=2)
                     continue
+                # no new bytes on the open fd: check whether the file
+                # was rotated (replaced: different inode at the path)
+                # or truncated (shrunk below our read position) and
+                # re-attach to the live generation if so
+                rotated = False
+                try:
+                    disk = os.stat(path)
+                    here = os.fstat(f.fileno())
+                    if disk.st_ino != here.st_ino:
+                        rotated = True
+                    elif disk.st_size < f.tell():
+                        rotated = True
+                except OSError:
+                    # path briefly absent mid-replace: treat as idle,
+                    # the next poll sees the new file
+                    pass
+                if rotated:
+                    warnings.warn(
+                        f"journal {path}: rotated mid-follow, "
+                        f"re-attached to the new generation"
+                        + (" (dropped a torn partial line)"
+                           if buf.strip() else ""), stacklevel=2)
+                    f.close()
+                    f = open(path)
+                    buf = ""
+                    idle = 0.0
+                    continue
                 if stop is not None and stop():
                     return
                 if idle_timeout is not None and idle >= idle_timeout:
                     return
                 sleep(poll_s)
                 idle += poll_s
+        finally:
+            f.close()
 
 
 # paths already warned about corrupt lines (once-per-file, process-wide)

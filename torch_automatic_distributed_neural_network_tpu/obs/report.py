@@ -497,7 +497,16 @@ def generate(target: str, metrics_path: str | None = None) -> dict:
     grejects = [e for e in events if e.get("name") == "gateway.reject"]
     greplans = [e for e in events if e.get("name") == "gateway.replan"]
     gscales = [e for e in events if e.get("name") == "gateway.scale"]
-    if greqs or grejects or greplans or gscales:
+    gfails = [e for e in events
+              if e.get("name") == "gateway.failover"
+              and e.get("kind") != "parked"]
+    ghedges = [e for e in events if e.get("name") == "gateway.hedge"]
+    gbreaker = [e for e in events if e.get("name") == "gateway.breaker"]
+    gdegrade = [e for e in events
+                if e.get("name") in ("gateway.degrade",
+                                     "gateway.restore")]
+    if (greqs or grejects or greplans or gscales or gfails
+            or ghedges or gbreaker or gdegrade):
         gw: dict[str, Any] = {
             "requests": len(greqs),
             "rejected": len(grejects),
@@ -505,6 +514,25 @@ def generate(target: str, metrics_path: str | None = None) -> dict:
                 1 for e in grejects if e.get("kind") == "rate_limit"),
             "rejected_backpressure": sum(
                 1 for e in grejects if e.get("kind") == "backpressure"),
+            "rejected_degraded": sum(
+                1 for e in grejects if e.get("kind") == "degraded"),
+            "failovers": [
+                {"t": e.get("t"), "replica": e.get("replica"),
+                 "reason": e.get("reason"),
+                 "n_requeued": e.get("n_requeued")}
+                for e in gfails],
+            "hedges_dispatched": sum(
+                1 for e in ghedges if e.get("kind") == "dispatch"),
+            "hedges_won": sum(
+                1 for e in ghedges if e.get("kind") == "win"
+                and e.get("winner") == "hedge"),
+            "breaker_opens": sum(
+                1 for e in gbreaker if e.get("to") == "open"),
+            "degrade_history": [
+                {"t": e.get("t"),
+                 "kind": e.get("name", ".").split(".", 1)[1],
+                 "level": e.get("level"), "reason": e.get("reason")}
+                for e in gdegrade],
             "replans": [
                 {"t": e.get("t"), "reason": e.get("reason"),
                  "current": e.get("current"), "chosen": e.get("chosen"),
@@ -1003,6 +1031,23 @@ def format_report(report: dict) -> str:
                 f"  {what} t={(sc.get('t') or 0.0):7.2f}s: "
                 f"{sc.get('replica')} -> fleet of "
                 f"{sc.get('n_replicas')}{extra}")
+        for fo in gw.get("failovers", ()):
+            lines.append(
+                f"  failover t={(fo.get('t') or 0.0):7.2f}s: "
+                f"{fo.get('replica')} ({fo.get('reason')}), "
+                f"{fo.get('n_requeued')} request(s) salvaged")
+        if gw.get("hedges_dispatched"):
+            lines.append(
+                f"  hedges: {gw['hedges_dispatched']} dispatched, "
+                f"{gw.get('hedges_won', 0)} won")
+        if gw.get("breaker_opens"):
+            lines.append(
+                f"  circuit breaker: opened "
+                f"{gw['breaker_opens']} time(s)")
+        for dg in gw.get("degrade_history", ()):
+            lines.append(
+                f"  {dg.get('kind')} t={(dg.get('t') or 0.0):7.2f}s: "
+                f"level {dg.get('level')} ({dg.get('reason') or '?'})")
         if gw.get("final_replicas") is not None:
             lines.append(
                 f"  final fleet: {gw['final_replicas']} replica(s)")
