@@ -1,0 +1,267 @@
+"""Async-safety lint tests (analysis/async_lint, AS001-AS004).
+
+Each rule gets a positive fixture (the bug class fires) and a negative
+fixture (the sanctioned idiom does not); suppression semantics match
+source_lint — `# tadnn: lint-ok(AS00x) <reason>` with a mandatory
+reason.  The final test pins the gateway package itself clean.
+"""
+
+import textwrap
+
+from torch_automatic_distributed_neural_network_tpu import analysis
+from torch_automatic_distributed_neural_network_tpu.analysis import async_lint
+
+
+def _lint(src):
+    return async_lint.lint_source(textwrap.dedent(src), "fixture.py")
+
+
+def codes(findings):
+    return [f.code for f in findings]
+
+
+class TestAS001Blocking:
+    def test_blocking_call_in_async_def(self):
+        fs = _lint("""
+            import time
+
+            async def pump(self):
+                time.sleep(0.1)
+        """)
+        assert codes(fs) == ["AS001"]
+        assert fs[0].severity == analysis.ERROR
+        assert "time.sleep" in fs[0].msg
+
+    def test_prefix_patterns_cover_subprocess_and_requests(self):
+        fs = _lint("""
+            import subprocess
+            import requests
+
+            async def deploy():
+                subprocess.run(["true"])
+                requests.get("http://example.com")
+        """)
+        assert codes(fs) == ["AS001", "AS001"]
+
+    def test_sync_def_is_not_flagged(self):
+        fs = _lint("""
+            import time
+
+            def blocking_helper():
+                time.sleep(0.1)
+        """)
+        assert fs == []
+
+    def test_nested_sync_def_inside_async_is_excluded(self):
+        # the nested def only runs when called — typically shipped to an
+        # executor, which is exactly the sanctioned pattern
+        fs = _lint("""
+            import time
+
+            async def pump():
+                def work():
+                    time.sleep(0.1)
+                return work
+        """)
+        assert fs == []
+
+
+class TestAS002DroppedCoroutine:
+    def test_bare_call_of_local_async_def(self):
+        fs = _lint("""
+            async def notify():
+                pass
+
+            async def handler():
+                notify()
+        """)
+        assert codes(fs) == ["AS002"]
+        assert "notify" in fs[0].msg
+
+    def test_bare_self_call_of_async_method(self):
+        fs = _lint("""
+            class Gateway:
+                async def _drain(self):
+                    pass
+
+                async def stop(self):
+                    self._drain()
+        """)
+        assert codes(fs) == ["AS002"]
+        assert "self._drain" in fs[0].msg
+
+    def test_awaited_and_tasked_calls_are_fine(self):
+        fs = _lint("""
+            import asyncio
+
+            async def notify():
+                pass
+
+            async def handler():
+                await notify()
+                asyncio.create_task(notify())
+        """)
+        assert fs == []
+
+    def test_foreign_calls_are_not_resolvable(self):
+        # `other.do()` could be sync for all the AST knows — no finding
+        fs = _lint("""
+            async def handler(other):
+                other.do()
+        """)
+        assert fs == []
+
+
+class TestAS003WallClock:
+    def test_wall_clock_in_clock_injected_class(self):
+        fs = _lint("""
+            import time
+
+            class Router:
+                def __init__(self, clock=time.monotonic):
+                    self.clock = clock
+
+                def age(self, t0):
+                    return time.monotonic() - t0
+        """)
+        assert codes(fs) == ["AS003"]
+        assert "Router" in fs[0].msg
+
+    def test_default_argument_is_the_sanctioned_idiom(self):
+        fs = _lint("""
+            import time
+
+            class Router:
+                def __init__(self, clock=time.monotonic):
+                    self.clock = clock
+
+                def now(self):
+                    return self.clock()
+        """)
+        assert fs == []
+
+    def test_asyncio_sleep_counts_as_wall_clock_here(self):
+        fs = _lint("""
+            import asyncio
+
+            class Breaker:
+                def __init__(self, clock):
+                    self.clock = clock
+
+                async def cool_down(self):
+                    await asyncio.sleep(1.0)
+        """)
+        assert codes(fs) == ["AS003"]
+
+    def test_clockless_class_may_sleep(self):
+        # no `clock` in __init__ -> the class never signed the contract
+        fs = _lint("""
+            import asyncio
+
+            class Ingress:
+                def __init__(self, port):
+                    self.port = port
+
+                async def poll(self):
+                    await asyncio.sleep(0.05)
+        """)
+        assert fs == []
+
+
+class TestAS004ThreadMutation:
+    def test_thread_target_mutating_attributes(self):
+        fs = _lint("""
+            import threading
+
+            class Sink:
+                def _write(self):
+                    self.n += 1
+
+                def start(self):
+                    threading.Thread(target=self._write).start()
+        """)
+        assert codes(fs) == ["AS004"]
+        assert fs[0].severity == analysis.WARN
+
+    def test_executor_submit_mutating_function(self):
+        fs = _lint("""
+            def bump(state):
+                state.count = 1
+
+            def kick(executor):
+                executor.submit(bump)
+        """)
+        assert codes(fs) == ["AS004"]
+
+    def test_non_executorish_submit_is_ignored(self):
+        # gateway.submit(request) is the serving API, not an executor
+        fs = _lint("""
+            def bump(state):
+                state.count = 1
+
+            def kick(gateway):
+                gateway.submit(bump)
+        """)
+        assert fs == []
+
+    def test_pure_target_is_fine(self):
+        fs = _lint("""
+            import threading
+
+            def compute(x):
+                return x * 2
+
+            def start():
+                threading.Thread(target=compute).start()
+        """)
+        assert fs == []
+
+
+class TestSuppression:
+    def test_suppression_with_reason_is_honored(self):
+        fs = _lint("""
+            import time
+
+            async def pump():
+                time.sleep(0.1)  # tadnn: lint-ok(AS001) startup only
+        """)
+        assert fs == []
+
+    def test_suppression_on_line_above(self):
+        fs = _lint("""
+            import time
+
+            async def pump():
+                # tadnn: lint-ok(AS001) startup only
+                time.sleep(0.1)
+        """)
+        assert fs == []
+
+    def test_suppression_without_reason_is_ignored(self):
+        fs = _lint("""
+            import time
+
+            async def pump():
+                time.sleep(0.1)  # tadnn: lint-ok(AS001)
+        """)
+        assert codes(fs) == ["AS001"]
+
+    def test_suppression_is_code_specific(self):
+        fs = _lint("""
+            import time
+
+            async def pump():
+                time.sleep(0.1)  # tadnn: lint-ok(AS003) wrong code
+        """)
+        assert codes(fs) == ["AS001"]
+
+
+def test_syntax_error_is_reported_not_raised():
+    fs = _lint("async def broken(:\n")
+    assert codes(fs) == ["AS001"]
+    assert "syntax error" in fs[0].msg
+
+
+def test_gateway_package_is_clean():
+    findings = async_lint.lint_paths()
+    assert findings == [], "\n".join(f.format() for f in findings)

@@ -1,0 +1,244 @@
+"""Protocol model checker tests (analysis/model_check + protocol).
+
+Fast tier: BFS/minimization/replay mechanics on a toy model, clean
+exploration of the cheap real models, scope floors, rule registration,
+and the CLI JSON shape.  Slow tier: the full five-model sweep and the
+seeded-mutation harness — every planted single-line protocol bug must
+produce a minimized counterexample that replays as a failure under the
+mutation and does NOT reproduce on clean code.
+"""
+
+import json
+
+import pytest
+
+from torch_automatic_distributed_neural_network_tpu import analysis
+from torch_automatic_distributed_neural_network_tpu.analysis import (
+    model_check,
+    protocol,
+)
+
+
+class _ToyModel(model_check.ProtocolModel):
+    """Two bounded counters; planted bug: b reaching 2 is illegal."""
+
+    name = "toy"
+    rule = "PC001"
+
+    def initial(self):
+        return {"a": 0, "b": 0}
+
+    def enabled(self, world):
+        evs = []
+        if world["a"] < 3:
+            evs.append(("inc_a",))
+        if world["b"] < 3:
+            evs.append(("inc_b",))
+        return evs
+
+    def apply(self, world, event):
+        world["a" if event[0] == "inc_a" else "b"] += 1
+
+    def violations(self, world):
+        if world["b"] >= 2:
+            return [("PC001", "b reached 2")]
+        return []
+
+    def quiescent(self, world):
+        return world["a"] == 3 and world["b"] == 3
+
+    def fingerprint(self, world):
+        return (world["a"], world["b"])
+
+
+def _toy_builder(name, scope):
+    assert name == "toy"
+    return _ToyModel(scope)
+
+
+def test_explore_finds_shortest_counterexample():
+    res = model_check.explore(_ToyModel())
+    assert res.complete
+    assert len(res.counterexamples) == 1
+    cx = res.counterexamples[0]
+    assert cx.code == "PC001"
+    assert cx.minimized
+    # BFS + greedy deletion: the minimal path is two inc_b events
+    assert cx.events == [("inc_b",), ("inc_b",)]
+
+
+def test_minimize_strips_irrelevant_events():
+    fat = model_check.Counterexample(
+        model="toy", scope={}, code="PC001", message="b reached 2",
+        events=[("inc_a",), ("inc_b",), ("inc_a",), ("inc_b",)])
+    slim = model_check.minimize(_ToyModel(), fat)
+    assert slim.minimized
+    assert slim.events == [("inc_b",), ("inc_b",)]
+
+
+def test_replay_detects_violation_and_inapplicable_scripts():
+    m = _ToyModel()
+    got = model_check.replay(m, [("inc_b",), ("inc_b",)])
+    assert got is not None and got[0] == "PC001"
+    # a clean prefix reports nothing
+    assert model_check.replay(_ToyModel(), [("inc_a",)]) is None
+    # an event that is not enabled -> the _INVALID sentinel
+    w_full = model_check.replay(
+        _ToyModel(), [("inc_a",)] * 3 + [("inc_a",)])
+    assert w_full is model_check._INVALID
+
+
+def test_script_save_load_replay_roundtrip(tmp_path):
+    res = model_check.explore(_ToyModel())
+    cx = res.counterexamples[0]
+    path = str(tmp_path / "toy-cx.json")
+    model_check.save_script(cx, path)
+    loaded = model_check.load_script(path)
+    assert loaded.events == cx.events
+    assert loaded.code == cx.code
+    with pytest.raises(model_check.ProtocolViolation) as ei:
+        model_check.replay_script(path, _toy_builder)
+    assert ei.value.code == "PC001"
+    # a script whose events no longer apply raises ValueError instead
+    stale = model_check.Counterexample(
+        model="toy", scope={}, code="PC001", message="",
+        events=[("inc_a",)] * 4)
+    with pytest.raises(ValueError):
+        model_check.replay_script(stale.to_json(), _toy_builder)
+
+
+def test_explore_truncation_is_reported():
+    res = model_check.explore(_ToyModel(), max_states=3)
+    assert not res.complete
+
+
+def test_pc_and_as_rules_registered():
+    for code in ("PC001", "PC002", "PC003", "PC004", "PC005", "PC006",
+                 "PC007"):
+        assert code in analysis.RULES
+        assert analysis.RULES[code].layer == "protocol"
+    for code in ("AS001", "AS002", "AS003", "AS004"):
+        assert code in analysis.RULES
+        assert analysis.RULES[code].layer == "async"
+
+
+def test_documented_scope_floor():
+    # the README/ISSUE scope contract at the default scope: >= 2
+    # replicas, >= 3 requests, >= 4 blocks (default_scope returns
+    # overrides; the resolved values live on the built models)
+    gw = protocol.build_model(
+        "gateway", protocol.default_scope("gateway"))
+    assert gw.n_replicas >= 2
+    assert len(gw.prompts) >= 3
+    alloc = protocol.build_model(
+        "allocator", protocol.default_scope("allocator"))
+    assert alloc.num_blocks >= 4
+    sched = protocol.build_model(
+        "scheduler-reserve", protocol.default_scope("scheduler-reserve"))
+    assert len(sched.requests) >= 3
+    assert sched.num_blocks >= 4
+    pfx = protocol.build_model(
+        "prefix", protocol.default_scope("prefix"))
+    assert pfx.num_blocks >= 4
+
+
+def test_cheap_models_explore_clean():
+    # allocator + reserve scheduler + gateway complete in a few seconds
+    # on CPU; the full five-model sweep (optimistic scheduler, prefix
+    # cache) runs in the slow tier and the CI --protocol leg
+    for name in ("allocator", "scheduler-reserve", "gateway"):
+        model = protocol.build_model(name, protocol.default_scope(name))
+        res = model_check.explore(model)
+        assert res.complete, f"{name} truncated at {res.states} states"
+        assert res.counterexamples == [], (
+            f"{name}: {res.counterexamples[0].code} "
+            f"{res.counterexamples[0].message}")
+        assert res.states > 100  # a real space, not a degenerate one
+
+
+def test_run_protocol_check_journals_and_writes_scripts(tmp_path):
+    class _Rec:
+        def __init__(self):
+            self.events = []
+
+        def event(self, name, **kw):
+            self.events.append((name, kw))
+
+    rec = _Rec()
+    findings, results = protocol.run_protocol_check(
+        models=["allocator"], counterexample_dir=str(tmp_path),
+        journal=rec)
+    assert findings == []
+    assert len(results) == 1 and results[0].complete
+    names = [n for n, _ in rec.events]
+    assert names == ["lint.protocol"]
+    payload = rec.events[0][1]
+    assert payload["model"] == "allocator"
+    assert payload["states"] == results[0].states
+    assert payload["complete"] is True
+    assert list(tmp_path.glob("*.json")) == []  # no violations on main
+
+
+@pytest.mark.slow
+def test_all_models_explore_clean_at_documented_scope():
+    for name in protocol.MODEL_NAMES:
+        model = protocol.build_model(name, protocol.default_scope(name))
+        res = model_check.explore(model)
+        assert res.complete, f"{name} truncated at {res.states} states"
+        assert res.counterexamples == [], (
+            f"{name}: {res.counterexamples[0].code} "
+            f"{res.counterexamples[0].message}")
+
+
+@pytest.mark.slow
+def test_mutation_harness_catches_every_planted_bug(tmp_path):
+    """The checker's own validation: each single-line mutation planted
+    in the real allocator/scheduler/cache/gateway must yield a
+    minimized counterexample that (a) replays as a ProtocolViolation
+    while the mutation is applied and (b) does not reproduce on clean
+    code (acceptance floor: >= 9/10; this asserts all of them)."""
+    caught = []
+    for name, mut in protocol.MUTATIONS.items():
+        res = protocol.run_mutation(name)
+        assert res.counterexamples, (
+            f"mutation {name!r} ({mut.note}) produced no counterexample")
+        cx = res.counterexamples[0]
+        assert cx.minimized
+        script = str(tmp_path / f"{name}.json")
+        model_check.save_script(cx, script)
+        # (a) replay IS a failing test while the bug is present
+        with mut.patch():
+            with pytest.raises(model_check.ProtocolViolation):
+                model_check.replay_script(script, protocol.build_model)
+        # (b) on clean code the script either passes or no longer
+        # applies (ValueError) — it must NOT report a violation
+        try:
+            model_check.replay_script(script, protocol.build_model)
+        except ValueError:
+            pass
+        caught.append(name)
+    assert len(caught) == len(protocol.MUTATIONS) >= 10
+
+
+@pytest.mark.slow
+def test_check_protocol_cli_json():
+    # the full sweep through the real CLI surface: --protocol --json
+    # emits per-model stats and exits 0 on a clean main (the CI leg's
+    # contract)
+    import os
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, "-m",
+         "torch_automatic_distributed_neural_network_tpu.cli",
+         "check", "--no-source", "--protocol", "--json"],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr
+    data = json.loads(out.stdout)
+    assert data["summary"]["errors"] == 0
+    models = {p["model"] for p in data["protocol"]}
+    assert models == set(protocol.MODEL_NAMES)
+    assert all(p["complete"] for p in data["protocol"])

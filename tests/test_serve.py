@@ -627,3 +627,102 @@ def test_priority_requeue_keeps_class_position():
     s.requeue(admitted[0][0])
     assert [q.rid for q in s.queue] == [inter.rid, batch.rid]
     s.check_invariants()
+
+
+# -- differential fuzz: Scheduler class vs pure decision functions ------------
+
+
+def test_fuzz_scheduler_matches_pure_functions():
+    """~1k fuzzed request streams: every admission round, prefill plan
+    and preemption the Scheduler class takes must match what the pure
+    module-level functions (admission_plan / prefill_schedule /
+    preemption_victim) decide from the same observable state — the
+    PR-12 equivalence pins, but over randomized schedules instead of
+    four hand-picked ones.  Host-only and fast: no engine, no jax."""
+    from torch_automatic_distributed_neural_network_tpu.inference.serve import (
+        admission_plan,
+        preemption_victim,
+        prefill_schedule,
+    )
+
+    rs = np.random.RandomState(1234)
+    for trial in range(1000):
+        n_slots = int(rs.randint(1, 5))
+        block_size = int(rs.choice([2, 4]))
+        num_blocks = int(rs.randint(4, 17))
+        admission = "optimistic" if rs.randint(2) else "reserve"
+        t = [0.0]
+        alloc = BlockAllocator(num_blocks=num_blocks)
+        sched = Scheduler(n_slots=n_slots, allocator=alloc,
+                          block_size=block_size, admission=admission,
+                          clock=lambda: t[0])
+        pending = [
+            Request(prompt=[1] * int(rs.randint(1, 10)),
+                    max_new_tokens=int(rs.randint(1, 5)),
+                    priority=int(rs.choice([0, 0, 1])))
+            for _ in range(int(rs.randint(1, 6)))
+        ]
+        ctx = f"trial {trial} ({admission}, slots={n_slots}, " \
+              f"blocks={num_blocks}x{block_size})"
+        for _ in range(12):
+            t[0] += 1.0
+            if pending and rs.rand() < 0.6:
+                sched.submit(pending.pop())
+            keys = [Scheduler._queue_key(r) for r in sched.queue]
+            assert keys == sorted(keys), ctx
+            planned = admission_plan(
+                [(r.n_prompt, r.max_new_tokens) for r in sched.queue],
+                sum(s is None for s in sched.slots), alloc.n_free,
+                block_size=block_size, admission=admission)
+            admitted = sched.admit()
+            assert len(admitted) == planned, ctx
+            for _slot, req in admitted:
+                req.state = "prefilling"  # chunked-prefill mode
+            budget = [1, 2, None][int(rs.randint(3))]
+            prefilling = [(r.t_admit, s)
+                          for s, r in enumerate(sched.slots)
+                          if r is not None and r.state == "prefilling"]
+            plan = sched.prefill_plan(budget)
+            assert [s for s, _ in plan] == \
+                prefill_schedule(prefilling, budget), ctx
+            for _slot, req in plan:
+                if rs.rand() < 0.5:  # this chunk completed the prompt
+                    req.state = "running"
+                    req.out_tokens.append(1)
+            for r in sched.slots:
+                if (r is not None and r.state == "running"
+                        and not r.finished()):
+                    r.out_tokens.append(1)
+            if admission == "optimistic" and rs.rand() < 0.3:
+                want = preemption_victim(
+                    [(r.t_admit, r.slot) for r in sched.slots
+                     if r is not None])
+                victim = sched.preempt_youngest()
+                assert (victim is None) == (want is None), ctx
+                if want is not None:
+                    assert victim is not None and victim.slot is None
+                    assert sched.slots[want] is None, ctx
+            for s, r in enumerate(list(sched.slots)):
+                if (r is not None and r.state == "running"
+                        and r.finished()):
+                    sched.evict(s)
+            sched.check_invariants()
+
+
+def test_debug_invariants_env_gate(monkeypatch):
+    """TADNN_DEBUG_INVARIANTS=1 arms the per-step invariant audit; ""
+    and "0" leave it off.  Run one short request through an armed engine
+    so the audit actually executes on every step."""
+    model, variables = _model_and_vars()
+    for value, armed in (("", False), ("0", False), ("1", True)):
+        if value:
+            monkeypatch.setenv("TADNN_DEBUG_INVARIANTS", value)
+        else:
+            monkeypatch.delenv("TADNN_DEBUG_INVARIANTS", raising=False)
+        eng = ServeEngine(model, variables, n_slots=2, max_len=32,
+                          block_size=8)
+        assert eng._debug_invariants is armed, value
+        if armed:
+            eng.submit([1, 2, 3], max_new_tokens=4, eos_id=0)
+            done = eng.run()
+            assert len(done) == 1

@@ -25,6 +25,14 @@ compile-time analyzability — see PAPERS.md):
 - **dtype lint** (:mod:`.dtype_lint`): abstract dtype propagation over
   the same trace — loss-path downcasts, f16 overflow-prone sums,
   weak types at collectives, mixed-dtype param trees.
+- **protocol check** (:mod:`.protocol` / :mod:`.model_check`): bounded
+  explicit-state BFS over event interleavings of the REAL serving
+  state machines (allocator, scheduler, prefix cache, gateway) from
+  small-scope initial states — safety + terminal liveness, with
+  minimized replayable counterexamples (``tadnn check --protocol``).
+- **async lint** (:mod:`.async_lint`): AST rules over the asyncio
+  gateway layer — blocking calls in async defs, dropped coroutines,
+  wall-clock reads in clock-injected classes.
 
 Findings are typed (``error``/``warn``), journaled as ``lint.*`` events,
 rendered by ``tadnn report``, runnable via ``tadnn check [--json]
@@ -145,6 +153,40 @@ RULES: dict[str, RuleInfo] = {
                  "surprise)"),
         RuleInfo("DT004", "dtype", WARN,
                  "param tree mixes float dtypes across leaves"),
+        RuleInfo("PC001", "protocol", ERROR,
+                 "block allocator safety violated under some event "
+                 "interleaving (leak / double-free / refcount-holder "
+                 "mismatch)"),
+        RuleInfo("PC002", "protocol", ERROR,
+                 "scheduler protocol violated (pin multiset != running "
+                 "slots, queue order, block conservation, over-"
+                 "generation)"),
+        RuleInfo("PC003", "protocol", ERROR,
+                 "prefix-cache lease protocol violated (expired-lease "
+                 "match, index/refcount divergence, leak on drop)"),
+        RuleInfo("PC004", "protocol", ERROR,
+                 "token ledger violated exactly-once (rewrote history, "
+                 "duplicated or skipped a token)"),
+        RuleInfo("PC005", "protocol", ERROR,
+                 "circuit breaker took an illegal state transition"),
+        RuleInfo("PC006", "protocol", ERROR,
+                 "liveness violated: quiescent state with unresolved "
+                 "rids / unfreed blocks, or a deadlocked interleaving"),
+        RuleInfo("PC007", "protocol", WARN,
+                 "model checker hit its state/depth cap before "
+                 "exhausting the scope (result is a partial proof)"),
+        RuleInfo("AS001", "async", ERROR,
+                 "blocking call inside an async def (stalls the event "
+                 "loop)"),
+        RuleInfo("AS002", "async", ERROR,
+                 "locally-defined coroutine called without await "
+                 "(created and dropped)"),
+        RuleInfo("AS003", "async", ERROR,
+                 "wall-clock / asyncio.sleep inside a clock-injected "
+                 "class (breaks virtual-time replay)"),
+        RuleInfo("AS004", "async", WARN,
+                 "attribute-mutating callable handed to a thread/"
+                 "executor (event loop loses ownership)"),
     )
 }
 

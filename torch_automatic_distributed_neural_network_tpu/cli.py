@@ -849,9 +849,10 @@ def cmd_check(args: argparse.Namespace) -> int:
         return 0
     findings: list = []
     if not args.no_source:
-        from .analysis import source_lint
+        from .analysis import async_lint, source_lint
 
         findings += source_lint.lint_paths(args.paths or None)
+        findings += async_lint.lint_paths(args.paths or None)
     if args.preflight:
         import importlib.util
 
@@ -888,6 +889,7 @@ def cmd_check(args: argparse.Namespace) -> int:
         )
         findings += mem_findings
     serve_est = None
+    serve_trace_stats = None
     if getattr(args, "serving", False):
         if args.family not in ("gpt2", "llama", "moe"):
             print("check --serving needs a decoder family "
@@ -931,6 +933,37 @@ def cmd_check(args: argparse.Namespace) -> int:
                 getattr(args, "serve_prefix_hit_rate", None) or 0.0),
             params_bytes=params_bytes, **kwargs)
         findings += s_findings
+        if getattr(args, "trace_serve", False):
+            from .analysis import serve_trace
+
+            variables = model.init(
+                jax.random.key(0),
+                jnp.zeros((1, min(8, cfg.max_seq_len)), jnp.int32))
+            t_findings, serve_trace_stats = serve_trace.serve_trace_check(
+                model, variables,
+                n_slots=4,
+                max_len=min(args.serve_max_len or 64, cfg.max_seq_len),
+                block_size=min(args.serve_block_size, 8),
+                quant_kv=args.serve_quant_kv,
+                attention_impl=args.serve_attention_impl,
+            )
+            findings += t_findings
+    protocol_results = None
+    if getattr(args, "protocol", False):
+        from .analysis import protocol as protocol_mod
+
+        p_findings, p_results = protocol_mod.run_protocol_check(
+            scope=args.scope,
+            counterexample_dir=args.counterexample_dir,
+        )
+        findings += p_findings
+        protocol_results = [
+            {"model": r.model, "scope": r.scope, "states": r.states,
+             "transitions": r.transitions, "depth": r.depth,
+             "frontier_peak": r.frontier_peak,
+             "wall_s": round(r.wall_s, 3), "complete": r.complete,
+             "violations": len(r.counterexamples)}
+            for r in p_results]
     try:
         findings = analysis.filter_ignored(findings, args.ignore or ())
     except ValueError as e:
@@ -945,6 +978,10 @@ def cmd_check(args: argparse.Namespace) -> int:
             out["memory"] = mem_report
         if serve_est is not None:
             out["serve_estimate"] = serve_est
+        if serve_trace_stats is not None:
+            out["serve_trace"] = serve_trace_stats
+        if protocol_results is not None:
+            out["protocol"] = protocol_results
         print(json.dumps(out))
     else:
         for f in findings:
@@ -972,6 +1009,17 @@ def cmd_check(args: argparse.Namespace) -> int:
                       f"at {serve_est['expected_hit_rate']:.0%} hit rate "
                       f"~{serve_est['effective_max_streams']} effective "
                       f"stream(s) (shared prefix blocks counted once)")
+        if serve_trace_stats is not None:
+            for tag, st in serve_trace_stats.items():
+                print(f"serve trace [{tag}]: {st['eqns']} eqn(s), "
+                      f"{st['collectives']} collective(s)")
+        if protocol_results is not None:
+            for r in protocol_results:
+                print(f"protocol [{r['model']}]: {r['states']} states / "
+                      f"{r['transitions']} transitions explored to depth "
+                      f"{r['depth']} in {r['wall_s']}s "
+                      f"({'complete' if r['complete'] else 'TRUNCATED'}"
+                      f", {r['violations']} violation(s))")
         print(f"tadnn check: {summary['errors']} error(s), "
               f"{summary['warnings']} warning(s)")
     return analysis.exit_code(findings, strict=args.strict)
@@ -2088,6 +2136,28 @@ def main(argv: list[str] | None = None) -> int:
                    help="ZeRO-1 for --memory: shard optimizer moments "
                         "over the data axis (the per-chip optimizer row "
                         "drops ~DP-fold)")
+    p.add_argument("--trace-serve", action="store_true",
+                   dest="trace_serve",
+                   help="with --serving: build a ServeEngine on the "
+                        "family config and run graph + dtype lint over "
+                        "its decode/prefill jaxprs (trace-only, the "
+                        "PR-14 eval_shape AOT operands)")
+    p.add_argument("--protocol", action="store_true",
+                   help="explicit-state model check of the serving "
+                        "control plane (allocator / scheduler / prefix "
+                        "cache / gateway): BFS over all event "
+                        "interleavings at --scope, PC0xx findings with "
+                        "minimized replayable counterexamples")
+    p.add_argument("--scope", type=int, default=1,
+                   help="protocol small-scope level (default 1: 2 "
+                        "replicas, 3 requests, 4+ blocks; 2 widens "
+                        "requests/windows — slower, exponentially "
+                        "larger space)")
+    p.add_argument("--counterexample-dir", default=None, metavar="DIR",
+                   dest="counterexample_dir",
+                   help="write minimized counterexamples as replayable "
+                        "JSON event scripts into DIR (replay via "
+                        "analysis.protocol.replay_script)")
     p.set_defaults(fn=cmd_check)
 
     p = sub.add_parser(

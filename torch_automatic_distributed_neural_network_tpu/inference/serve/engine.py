@@ -81,6 +81,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import os
 import time
 from functools import partial
 from typing import Any
@@ -494,6 +495,11 @@ class ServeEngine:
             spec_lookahead=self.speculative,
             prefix_cache=self._prefix_cache, match_align=match_align)
         self._rng = jax.random.key(0) if rng is None else rng
+        # TADNN_DEBUG_INVARIANTS=1: run the scheduler/allocator/adapter
+        # invariant audit after EVERY step (CI serve-smoke legs set it;
+        # off by default — it walks all slots and the free list)
+        self._debug_invariants = (
+            os.environ.get("TADNN_DEBUG_INVARIANTS", "") not in ("", "0"))
         self._step_count = 0
         self._occupancy_sum = 0.0
         # per-phase busy time, the bench's per-slice breakdown: what
@@ -1099,6 +1105,8 @@ class ServeEngine:
                       else "colocated"),
                 overlap_s=overlap_s,
                 **adapter_stats)
+        if self._debug_invariants:
+            sched.check_invariants()
 
     @property
     def prefix_cache(self) -> PrefixCache | None:

@@ -583,6 +583,13 @@ def generate(target: str, metrics_path: str | None = None) -> dict:
         if lint_skipped:
             lint["skipped"] = lint_skipped.get("error")
         report["lint"] = {k: v for k, v in lint.items() if v is not None}
+    protocol = [e for e in events if e.get("name") == "lint.protocol"]
+    if protocol:
+        report["protocol"] = [
+            {k: e.get(k) for k in ("model", "scope", "states",
+                                   "transitions", "depth", "frontier_peak",
+                                   "wall_s", "complete", "violations")}
+            for e in protocol]
     mem_est = last("lint.mem_estimate")
     if mem_est:
         keys = ("params_bytes", "optimizer_bytes", "model_state_bytes",
@@ -1145,6 +1152,21 @@ def format_report(report: dict) -> str:
                          f"{f.get('where')}: {f.get('msg')}")
         if lint.get("skipped"):
             lines.append(f"  preflight skipped: {lint['skipped']}")
+    protocol = report.get("protocol")
+    if protocol:
+        lines.append("protocol model check:")
+        for p in protocol:
+            status = ("ok" if p.get("complete") and not p.get("violations")
+                      else "TRUNCATED" if not p.get("complete")
+                      else "VIOLATED")
+            lines.append(
+                f"  {p.get('model')}: {p.get('states')} states / "
+                f"{p.get('transitions')} transitions, depth "
+                f"{p.get('depth')}, frontier peak "
+                f"{p.get('frontier_peak')}, {p.get('wall_s')}s — "
+                f"{status}"
+                + (f" ({p.get('violations')} counterexample(s))"
+                   if p.get("violations") else ""))
     me = report.get("memory_estimate")
     if me:
         mesh = "x".join(f"{a}{n}" for a, n in
