@@ -1,6 +1,6 @@
 """Static sharding/graph/source analysis — ``tadnn check`` + preflight.
 
-Three lint layers, one :class:`Finding` vocabulary (ISSUE 4; TorchTitan
+Eight lint layers, one :class:`Finding` vocabulary (ISSUE 4; TorchTitan
 validates its parallelism configs before launch, SimpleFSDP leans on
 compile-time analyzability — see PAPERS.md):
 
@@ -33,6 +33,13 @@ compile-time analyzability — see PAPERS.md):
 - **async lint** (:mod:`.async_lint`): AST rules over the asyncio
   gateway layer — blocking calls in async defs, dropped coroutines,
   wall-clock reads in clock-injected classes.
+- **journal lint** (:mod:`.journal_lint`): telemetry contract flow
+  check — every ``journal.event``/``journal.span`` emission and every
+  consumption site resolved statically and checked both ways against
+  the :mod:`..obs.schema` event registry (unknown kinds, missing or
+  mistyped fields, dead schema weight, reads of never-emitted fields,
+  deprecated aliases); ``tadnn check --journal`` plus a record-level
+  auditor for recorded journals (``--journal-file``).
 
 Findings are typed (``error``/``warn``), journaled as ``lint.*`` events,
 rendered by ``tadnn report``, runnable via ``tadnn check [--json]
@@ -187,6 +194,26 @@ RULES: dict[str, RuleInfo] = {
         RuleInfo("AS004", "async", WARN,
                  "attribute-mutating callable handed to a thread/"
                  "executor (event loop loses ownership)"),
+        RuleInfo("JL001", "journal", ERROR,
+                 "unknown journal event kind (emitted or consumed, not "
+                 "in the obs/schema.py registry)"),
+        RuleInfo("JL002", "journal", ERROR,
+                 "required payload field missing at a journal emission "
+                 "site"),
+        RuleInfo("JL003", "journal", ERROR,
+                 "literal payload value type-incompatible with the "
+                 "event schema"),
+        RuleInfo("JL004", "journal", ERROR,
+                 "payload field emitted but never declared (closed-"
+                 "schema drift)"),
+        RuleInfo("JL005", "journal", WARN,
+                 "declared optional field never emitted by any producer "
+                 "(dead schema)"),
+        RuleInfo("JL006", "journal", ERROR,
+                 "consumer reads a payload field no producer declares"),
+        RuleInfo("JL007", "journal", WARN,
+                 "emission or hardcoded consumer acceptance under a "
+                 "deprecated event-name alias"),
     )
 }
 

@@ -844,6 +844,13 @@ def cmd_check(args: argparse.Namespace) -> int:
     from . import analysis
 
     if args.rules:
+        if getattr(args, "journal", False):
+            # the generated journal event reference: the registry as a
+            # markdown table (the README's "Telemetry contracts" docs)
+            from .obs import schema as obs_schema
+
+            print(obs_schema.registry_markdown())
+            return 0
         for r in analysis.RULES.values():
             print(f"{r.code}  {r.layer:<6} {r.severity:<5} {r.title}")
         return 0
@@ -964,6 +971,32 @@ def cmd_check(args: argparse.Namespace) -> int:
              "wall_s": round(r.wall_s, 3), "complete": r.complete,
              "violations": len(r.counterexamples)}
             for r in p_results]
+    journal_stats = None
+    if getattr(args, "journal", False) or getattr(args, "journal_file",
+                                                  None):
+        from .analysis import journal_lint
+        from .obs import journal as obs_journal
+
+        journal_stats = {}
+        if getattr(args, "journal", False):
+            j_findings, journal_stats = journal_lint.lint_paths(
+                args.paths or None)
+            findings += j_findings
+            obs_journal.event(
+                "lint.journal",
+                kinds_emitted=journal_stats.get("kinds_emitted", 0),
+                kinds_known=journal_stats.get("kinds_known", 0),
+                sites=journal_stats.get("sites", 0),
+                dynamic_sites=journal_stats.get("dynamic_sites", 0),
+                coverage=journal_stats.get("coverage", 1.0),
+                findings=len(j_findings))
+        audits = {}
+        for jf in (getattr(args, "journal_file", None) or ()):
+            a_findings, a_stats = journal_lint.audit_journal(jf)
+            findings += a_findings
+            audits[jf] = {**a_stats, "findings": len(a_findings)}
+        if audits:
+            journal_stats = {**journal_stats, "audited": audits}
     try:
         findings = analysis.filter_ignored(findings, args.ignore or ())
     except ValueError as e:
@@ -982,6 +1015,8 @@ def cmd_check(args: argparse.Namespace) -> int:
             out["serve_trace"] = serve_trace_stats
         if protocol_results is not None:
             out["protocol"] = protocol_results
+        if journal_stats is not None:
+            out["journal"] = journal_stats
         print(json.dumps(out))
     else:
         for f in findings:
@@ -1020,6 +1055,19 @@ def cmd_check(args: argparse.Namespace) -> int:
                       f"{r['depth']} in {r['wall_s']}s "
                       f"({'complete' if r['complete'] else 'TRUNCATED'}"
                       f", {r['violations']} violation(s))")
+        if journal_stats is not None and journal_stats.get("sites"):
+            print(f"journal contract: {journal_stats['kinds_emitted']} "
+                  f"event kind(s) across {journal_stats['sites']} "
+                  f"emission site(s) "
+                  f"(+{journal_stats['dynamic_sites']} dynamic), "
+                  f"registry coverage "
+                  f"{journal_stats['coverage']:.0%} of "
+                  f"{journal_stats['kinds_known']} declared kind(s)")
+        if journal_stats is not None:
+            for jf, st in (journal_stats.get("audited") or {}).items():
+                print(f"journal audit [{jf}]: {st['records']} record(s)"
+                      + (f", {st['torn']} torn" if st["torn"] else "")
+                      + f", {st['findings']} finding(s)")
         print(f"tadnn check: {summary['errors']} error(s), "
               f"{summary['warnings']} warning(s)")
     return analysis.exit_code(findings, strict=args.strict)
@@ -2158,6 +2206,17 @@ def main(argv: list[str] | None = None) -> int:
                    help="write minimized counterexamples as replayable "
                         "JSON event scripts into DIR (replay via "
                         "analysis.protocol.replay_script)")
+    p.add_argument("--journal", action="store_true",
+                   help="journal telemetry contract lint (JL00x): "
+                        "resolve every event emission/consumption site "
+                        "against the obs/schema.py registry; with "
+                        "--rules, print the registry as a markdown "
+                        "event reference instead")
+    p.add_argument("--journal-file", action="append", default=None,
+                   metavar="FILE", dest="journal_file",
+                   help="audit a committed/artifact JSONL journal "
+                        "record-by-record against the event schema "
+                        "registry (repeatable)")
     p.set_defaults(fn=cmd_check)
 
     p = sub.add_parser(

@@ -1181,7 +1181,9 @@ class AutoDistribute:
         rec = {"event": name, "fn": fn_name, "dur_s": dt,
                "signature": _signature_str(key)}
         self.compile_events.append(rec)
-        obs_journal.event(name, fn=fn_name, dur_s=dt,
+        # literal branch so the journal lint resolves both kinds here
+        obs_journal.event("compile" if first else "recompile",
+                          fn=fn_name, dur_s=dt,
                           signature=rec["signature"])
         return out
 

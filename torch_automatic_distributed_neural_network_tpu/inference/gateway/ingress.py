@@ -401,7 +401,8 @@ class Gateway:
         self.router.forget(replica.name)
         self.n_failovers += 1
         self.journal.event(
-            "gateway.failover", replica=replica.name, reason=reason,
+            "gateway.failover", kind="redispatch",
+            replica=replica.name, reason=reason,
             n_requeued=len(salvaged),
             rids=[self._gw_rid(r.rid) for r in salvaged])
         self._redispatch(salvaged)

@@ -7,7 +7,7 @@ a process-global journal (``set_default`` / ``TADNN_JOURNAL`` env); when
 none is installed every call is a cheap no-op.
 """
 
-from . import aggregate, live, slo_monitor, trace
+from . import aggregate, live, schema, slo_monitor, trace
 from .goodput import BUCKETS, GoodputMeter
 from .journal import (
     Journal,
@@ -30,6 +30,7 @@ __all__ = [
     "SLOMonitor",
     "aggregate",
     "as_default",
+    "schema",
     "event",
     "get_default",
     "set_default",

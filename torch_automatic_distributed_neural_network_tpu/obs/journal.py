@@ -58,13 +58,22 @@ class Journal:
     ``<path>.1`` (one generation, overwritten) and the journal keeps
     appending to a fresh file — a long-running server's journal can
     never eat the disk.
+
+    ``validate=True`` (or ``TADNN_JOURNAL_VALIDATE=1``) checks every
+    record against the event schema registry (:mod:`.schema`) at emit
+    time and raises :class:`~.schema.JournalContractError` on drift —
+    the runtime half of the telemetry contract, on for CI smoke legs.
     """
 
     def __init__(self, path: str | None = None, *,
                  host0_only: bool = True, meta: dict | None = None,
-                 max_bytes: int | None = None,
+                 max_bytes: int | None = None, validate: bool | None = None,
                  clock=time.monotonic):
         self.path = path
+        if validate is None:
+            validate = os.environ.get(
+                "TADNN_JOURNAL_VALIDATE", "").strip() not in ("", "0")
+        self.validate = validate
         self.enabled = (not host0_only) or _process_index() == 0
         # ``t`` stamps come from here: inject a virtual clock and every
         # record's event-time is replayable (the gateway's chaos test
@@ -99,6 +108,19 @@ class Journal:
     def _write(self, rec: dict) -> None:
         if not self.enabled:
             return
+        if self.validate:
+            # runtime contract enforcement (opt-in; CI smoke legs run
+            # with TADNN_JOURNAL_VALIDATE=1): every record must honor
+            # its declared schema or the producer fails loudly here,
+            # at the drifting emission site
+            from . import schema as _schema
+
+            problems = _schema.validate_record(rec)
+            if problems:
+                detail = "; ".join(f"{c}: {m}" for c, m in problems)
+                raise _schema.JournalContractError(
+                    f"journal record violates its event schema "
+                    f"({detail})")
         self.counts[rec.get("name", "?")] = (
             self.counts.get(rec.get("name", "?"), 0) + 1
         )
@@ -350,6 +372,7 @@ class _NullJournal(Journal):
     def __init__(self):  # noqa: D401 — deliberately skips Journal.__init__
         self.path = None
         self.enabled = False
+        self.validate = False
         self._file = None
         self.records = []
         self.counts = {}

@@ -26,6 +26,8 @@ import math
 import time
 from typing import Any, Iterable, Iterator
 
+from .schema import names_for
+
 # bucket boundaries grow geometrically by this factor: a reported
 # percentile sits at its bucket's geometric midpoint, i.e. within
 # sqrt(GROWTH) of the true value — <= ~4% relative error
@@ -220,7 +222,9 @@ class LiveAggregator:
         return closed
 
     def _fold(self, w: _Window, rec: dict, name: str) -> None:
-        if name == "serve.request_done" or name == "serve.request":
+        # alias-resolved acceptance: the schema registry supplies the
+        # deprecated names too, so pre-rename journals still fold
+        if name in names_for("serve.request_done"):
             w.n_done += 1
             ttft = _num(rec.get("ttft_s"))
             if ttft is not None:

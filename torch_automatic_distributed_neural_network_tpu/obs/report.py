@@ -16,6 +16,7 @@ import os
 from typing import Any
 
 from .journal import Journal
+from .schema import names_for
 
 # merged-first: a multihost run's aggregate view (obs/aggregate.py)
 # carries host tags the per-host files lack
@@ -326,10 +327,10 @@ def generate(target: str, metrics_path: str | None = None) -> dict:
                                       if durs else None),
             }
         report["launch"] = launch
-    # r06 renamed serve.request -> serve.request_done (full span
-    # timeline); older committed journals still render
+    # the registry's deprecation table supplies every name this event
+    # was ever emitted under (the r06 rename); older journals render
     sreqs = [e for e in events
-             if e.get("name") in ("serve.request", "serve.request_done")]
+             if e.get("name") in names_for("serve.request_done")]
     ssteps = [e for e in events if e.get("name") == "serve.step"]
     spreempt = [e for e in events if e.get("name") == "serve.preempt"]
     sengine = last("serve.engine")

@@ -132,8 +132,11 @@ class SLOMonitor:
                             "violations": violations}
         if incident is not None:
             self.incidents.append(incident)
+            # literal branch (not "slo." + kind) so the journal lint
+            # can resolve both kinds at this site statically
             self.journal.event(
-                "slo." + incident["kind"],
+                "slo.breach" if incident["kind"] == "breach"
+                else "slo.recover",
                 **{k: v for k, v in incident.items() if k != "kind"})
         return incident
 

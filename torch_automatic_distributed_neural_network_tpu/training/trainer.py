@@ -235,6 +235,7 @@ class Trainer:
             )
         except Exception as e:
             obs_journal.event("lint.skipped", phase="preflight",
+                              layer="preflight",
                               error=f"{type(e).__name__}: {e}")
             return
         if findings and jax.process_index() == 0:
